@@ -1,0 +1,14 @@
+from .config import (  # noqa: F401
+    LossConfig,
+    OptimConfig,
+    DataConfig,
+    MeshConfig,
+    TrainConfig,
+    ExperimentConfig,
+    FLYINGCHAIRS,
+    FLYINGCHAIRS_VGG,
+    SINTEL,
+    UCF101,
+    PRESETS,
+    get_config,
+)

@@ -1,0 +1,188 @@
+"""Experiment configuration.
+
+One frozen dataclass tree per experiment replaces the reference's scattered
+`tf.app.flags` + hard-coded constants + placeholder-fed hyper-parameter lists
+(reference `flyingChairsTrain.py:14-53`, `sintelTrain.py:13-56`,
+`version1/deepOF.py:12-35`, `version1/trainOF.py:45-53`).
+
+Presets encode the reference's published hyper-parameter baselines
+(see BASELINE.md table): FlyingChairs, FlyingChairs-VGG, Sintel, UCF-101.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class LossConfig:
+    """Unsupervised pyramid-loss hyper-parameters.
+
+    Mirrors the reference's (epsilon, alpha_c, alpha_s, lambda_smooth)
+    quadruple (`flyingChairsWrapFlow.py:43-46`, `sintelTrain.py:50-53`,
+    `version1/trainOF.py:45-53`) plus structural switches for the smoothness
+    variant and edge-aware weighting.
+    """
+
+    epsilon: float = 1e-4
+    alpha_c: float = 0.25
+    alpha_s: float = 0.37
+    lambda_smooth: float = 1.0
+    # Per-scale loss weights, finest (pr1) first — reference weight_L
+    # schedules e.g. [16,8,4,2,1,1] (`flyingChairsTrain.py:165`).
+    weights: tuple[float, ...] = (16.0, 8.0, 4.0, 2.0, 1.0, 1.0)
+    # "canonical": fused forward-difference filter (x-grad of U, y-grad of V;
+    #   `flyingChairsWrapFlow.py:854`); "depthwise": both-direction gradients
+    #   per component (`version1/model/warpflow.py:133-136`).
+    smoothness: str = "canonical"
+    # Edge-aware Sobel image-gradient weighting of the smoothness term
+    # (`loss_interp_bk`, `version1/model/warpflow.py:93-157`).
+    edge_aware: bool = False
+    # Smooth the *scaled* flow (canonical `flyingChairsWrapFlow.py:785,854`)
+    # vs the raw head output (gen-1 `version1/model/warpflow.py:37,133`).
+    smooth_scaled_flow: bool = True
+    border_ratio: float = 0.1
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Adam + stepwise LR decay (reference `flyingChairsTrain.py:27-33,124`)."""
+
+    learning_rate: float = 1.6e-5
+    decay_factor: float = 0.5
+    epochs_per_decay: int = 18
+    beta1: float = 0.9
+    beta2: float = 0.999
+    adam_eps: float = 1e-8
+    grad_clip_norm: float | None = None
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "flyingchairs"  # flyingchairs | sintel | ucf101 | synthetic
+    data_path: str = ""
+    image_size: tuple[int, int] = (384, 512)  # (H, W) network input
+    gt_size: tuple[int, int] = (384, 512)  # native ground-truth resolution
+    batch_size: int = 4
+    time_step: int = 2  # frames per sample; Sintel volumes use 10
+    sintel_pass: str = "final"  # clean | final
+    # Host-side augmentation streams (reference `flyingChairsTrain_vgg.py:186-195`):
+    # photometric-augmented pair feeds the network, geometric-only feeds the loss.
+    augment_geo: bool = False
+    augment_photo: bool = False
+    crop_size: tuple[int, int] | None = None
+    prefetch: int = 2
+    cache_decoded: bool = True
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh axes for pjit sharding (no reference equivalent; the
+    reference is single-GPU, `flyingChairsTrain.py:99`)."""
+
+    data: int = -1  # -1: all available devices on the data axis
+    spatial: int = 1  # spatial context-parallel shards of H
+    time: int = 1  # temporal pair-parallel shards (Sintel T-1 pairs)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    num_epochs: int = 110
+    log_every: int = 500
+    eval_every: int = 5000  # steps; 0 = only at epoch end
+    ckpt_every_epochs: int = 18
+    keep_ckpts: int = 3
+    seed: int = 0
+    log_dir: str = "/tmp/deepof_tpu"
+    # eval protocol: finest flow is multiplied by `amplifier`, clipped, and
+    # resized to gt_size before AEE (`flyingChairsTrain.py:264-296`).
+    eval_amplifier: float = 2.0
+    eval_clip: tuple[float, float] = (-300.0, 250.0)
+    eval_batch_size: int = 8
+    nan_guard: bool = True
+    dump_visuals: bool = False
+    compute_dtype: str = "float32"  # float32 | bfloat16
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "flyingchairs_flownet_s"
+    model: str = "flownet_s"  # flownet_s|vgg16|inception_v3|flownet_c|st_single|st_baseline
+    loss: LossConfig = field(default_factory=LossConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    def replace(self, **kw: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# --- Presets: reference hyper-parameter baselines (BASELINE.md) ---
+
+FLYINGCHAIRS = ExperimentConfig(
+    name="flyingchairs_inception",
+    model="inception_v3",
+    loss=LossConfig(epsilon=1e-4, alpha_c=0.25, alpha_s=0.37, lambda_smooth=1.0,
+                    weights=(16, 8, 4, 2, 1, 1)),
+    optim=OptimConfig(learning_rate=1.6e-5, epochs_per_decay=18),
+    # network input 320x448 (`deepOF.py:22`), GT kept native 384x512
+    # (`flyingChairsLoader.py:74-81`); eval resizes pr1*2 back to gt_size.
+    data=DataConfig(dataset="flyingchairs", image_size=(320, 448),
+                    gt_size=(384, 512), batch_size=4),
+    train=TrainConfig(num_epochs=110, ckpt_every_epochs=18,
+                      eval_amplifier=2.0, eval_clip=(-300.0, 250.0)),
+)
+
+FLYINGCHAIRS_VGG = ExperimentConfig(
+    name="flyingchairs_vgg",
+    model="vgg16",
+    loss=LossConfig(epsilon=1e-4, alpha_c=0.25, alpha_s=0.37, lambda_smooth=1.0,
+                    weights=(16, 8, 4, 2, 1), smoothness="depthwise"),
+    optim=OptimConfig(learning_rate=1.6e-5, epochs_per_decay=18),
+    data=DataConfig(dataset="flyingchairs", image_size=(320, 448),
+                    gt_size=(384, 512), batch_size=8,
+                    augment_geo=True, augment_photo=True),
+    # pr1 is half the final flow: x2 before clip (`flyingChairsTrain_vgg.py:291-292`)
+    train=TrainConfig(num_epochs=110, eval_amplifier=2.0,
+                      eval_clip=(-204.4790, 201.3478)),
+)
+
+SINTEL = ExperimentConfig(
+    name="sintel_inception_multiframe",
+    model="inception_v3",
+    loss=LossConfig(epsilon=1e-4, alpha_c=0.3, alpha_s=0.3, lambda_smooth=0.0,
+                    weights=(16, 8, 4, 4, 2, 1)),
+    optim=OptimConfig(learning_rate=1.6e-5, epochs_per_decay=60),
+    data=DataConfig(dataset="sintel", image_size=(256, 512), gt_size=(436, 1024),
+                    crop_size=(224, 480), batch_size=4, time_step=10,
+                    sintel_pass="final"),
+    train=TrainConfig(num_epochs=400, ckpt_every_epochs=30, eval_amplifier=3.0,
+                      eval_clip=(-420.621, 426.311)),
+)
+
+UCF101 = ExperimentConfig(
+    name="ucf101_st_single",
+    model="st_single",
+    loss=LossConfig(epsilon=1e-4, alpha_c=0.25, alpha_s=0.37, lambda_smooth=0.8,
+                    weights=(16, 8, 4, 2, 1)),
+    optim=OptimConfig(learning_rate=1.6e-4, epochs_per_decay=50),
+    # gen-2 entry trains 320x384 (`deepOF.py:19`), 1000 epochs (`ucf101train.py:50`)
+    data=DataConfig(dataset="ucf101", image_size=(320, 384),
+                    gt_size=(320, 384), batch_size=8),
+    train=TrainConfig(num_epochs=1000, eval_amplifier=1.0, eval_clip=(-1e9, 1e9)),
+)
+
+PRESETS: dict[str, ExperimentConfig] = {
+    "flyingchairs": FLYINGCHAIRS,
+    "flyingchairs_vgg": FLYINGCHAIRS_VGG,
+    "sintel": SINTEL,
+    "ucf101": UCF101,
+}
+
+
+def get_config(name: str, **overrides: Any) -> ExperimentConfig:
+    cfg = PRESETS[name]
+    return cfg.replace(**overrides) if overrides else cfg

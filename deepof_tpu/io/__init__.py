@@ -1,0 +1,1 @@
+from .flo import read_flo, write_flo, FLO_TAG  # noqa: F401

@@ -1,0 +1,2 @@
+from .metrics import flow_epe, flow_aae  # noqa: F401
+from .flowviz import flow_to_color  # noqa: F401

@@ -1,0 +1,38 @@
+import numpy as np
+import pytest
+
+from deepof_tpu.io import read_flo, write_flo, FLO_TAG
+
+
+def test_roundtrip(tmp_path, rng):
+    flow = rng.randn(17, 23, 2).astype(np.float32) * 20
+    p = tmp_path / "a.flo"
+    write_flo(p, flow)
+    out = read_flo(p)
+    np.testing.assert_array_equal(out, flow)
+
+
+def test_header_layout(tmp_path):
+    """Middlebury layout: float32 tag, int32 w, int32 h, then u,v interleaved."""
+    flow = np.zeros((2, 3, 2), np.float32)
+    flow[0, 1] = (5.0, -7.0)
+    p = tmp_path / "b.flo"
+    write_flo(p, flow)
+    raw = p.read_bytes()
+    assert np.frombuffer(raw[:4], np.float32)[0] == np.float32(FLO_TAG)
+    w, h = np.frombuffer(raw[4:12], np.int32)
+    assert (w, h) == (3, 2)
+    data = np.frombuffer(raw[12:], np.float32).reshape(2, 3, 2)
+    assert data[0, 1, 0] == 5.0 and data[0, 1, 1] == -7.0
+
+
+def test_bad_tag(tmp_path):
+    p = tmp_path / "c.flo"
+    p.write_bytes(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        read_flo(p)
+
+
+def test_bad_shape(tmp_path):
+    with pytest.raises(ValueError):
+        write_flo(tmp_path / "d.flo", np.zeros((4, 4, 3), np.float32))

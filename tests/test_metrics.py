@@ -1,0 +1,59 @@
+import numpy as np
+
+from deepof_tpu.utils import flow_epe, flow_aae
+from deepof_tpu.utils.flowviz import flow_to_color, make_colorwheel
+
+
+def test_epe_zero():
+    f = np.random.RandomState(1).randn(2, 8, 8, 2)
+    assert flow_epe(f, f) == 0.0
+
+
+def test_epe_known():
+    gt = np.zeros((1, 4, 4, 2))
+    pred = np.zeros((1, 4, 4, 2))
+    pred[..., 0] = 3.0
+    pred[..., 1] = 4.0
+    assert np.isclose(flow_epe(pred, gt), 5.0)
+
+
+def test_epe_masked():
+    gt = np.zeros((1, 2, 2, 2))
+    pred = np.zeros((1, 2, 2, 2))
+    pred[0, 0, 0] = (3.0, 4.0)
+    mask = np.zeros((1, 2, 2))
+    mask[0, 0, 0] = 1
+    assert np.isclose(flow_epe(pred, gt, mask), 5.0)
+
+
+def test_aae_matches_reference_formula(rng):
+    """Cross-check against a direct transcription of utils.py:70-80."""
+    f1 = rng.randn(2, 6, 7, 2)
+    f2 = rng.randn(2, 6, 7, 2)
+    u, v = f1[..., 0], f1[..., 1]
+    ug, vg = f2[..., 0], f2[..., 1]
+    num = 1 + u * ug + v * vg
+    den = np.sqrt(1 + u**2 + v**2) * np.sqrt(1 + ug**2 + vg**2)
+    expect = np.arccos(np.clip(num / den, -1, 1)).mean()
+    assert np.isclose(flow_aae(f1, f2), expect)
+
+
+def test_colorwheel_shape():
+    w = make_colorwheel()
+    assert w.shape == (55, 3)
+    assert w.min() >= 0 and w.max() <= 1
+
+
+def test_flow_to_color():
+    flow = np.zeros((16, 16, 2), np.float32)
+    flow[:, :8, 0] = 10.0
+    flow[:, 8:, 0] = -10.0
+    img = flow_to_color(flow)
+    assert img.shape == (16, 16, 3) and img.dtype == np.uint8
+    # opposite directions must land on different colors
+    assert np.any(img[0, 0] != img[0, 15])
+
+
+def test_flow_to_color_zero_flow_is_white():
+    img = flow_to_color(np.zeros((4, 4, 2)))
+    assert (img >= 250).all()
