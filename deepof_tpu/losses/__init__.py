@@ -1,0 +1,9 @@
+from .photometric import (  # noqa: F401
+    border_mask,
+    smoothness_mask_x,
+    smoothness_mask_y,
+    charbonnier,
+    loss_interp,
+    loss_interp_multi,
+)
+from .pyramid import pyramid_loss, pyramid_loss_multi  # noqa: F401

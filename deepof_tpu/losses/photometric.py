@@ -1,0 +1,198 @@
+"""Unsupervised photometric warp losses.
+
+Pure-function re-design of the reference's `loss_interp` family, which is
+duplicated with variations across five files (SURVEY.md §2.4):
+
+  - canonical 2-frame (`flyingChairsWrapFlow.py:752-876`)
+  - UCF variant: border mask also applied to the smoothness term
+    (`ucf101wrapFlow.py:471-472`)
+  - depthwise/gen-1 variant: both-direction gradients per flow component,
+    optional Sobel edge-aware weighting (`version1/model/warpflow.py:4-173`,
+    `flyingChairsWrapFlow_vgg.py:135-317`)
+  - multi-frame volume variant (`sintelWrapFlow.py:492-630`)
+
+All variants here are vectorized jnp (no python loops over batch/channels)
+and driven by `core.config.LossConfig`. Loss dict keys mirror the reference:
+total / Charbonnier_reconstruct / U_loss / V_loss.
+
+Replicated behavioral details (deliberate, for numeric parity):
+  - the Charbonnier normalizer is the count of border-mask-interior *image*
+    elements (B * interior * C), reused for the smoothness normalizer
+    (canonical) or scaled by 2/3 (depthwise variant);
+  - masks multiply the *gradient* before the Charbonnier power, so masked
+    pixels still contribute (eps^2)^alpha_s (a constant offset) — except in
+    the depthwise variant where the border mask multiplies after;
+  - photometric diff is scaled by 255 before the Charbonnier power.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.config import LossConfig
+from ..ops.warp import backward_warp, backward_warp_volume
+from ..ops.smoothness import (
+    forward_diff_x,
+    forward_diff_y,
+    sobel_gradients,
+    to_grayscale,
+)
+
+LossDict = dict[str, Any]
+
+
+def charbonnier(x: jnp.ndarray, eps: float, alpha: float) -> jnp.ndarray:
+    """(x^2 + eps^2)^alpha — the generalized Charbonnier penalty."""
+    return jnp.power(jnp.square(x) + eps * eps, alpha)
+
+
+def border_mask(h: int, w: int, ratio: float = 0.1) -> jnp.ndarray:
+    """(H, W) float mask: 0 in a ceil(ratio*H)-wide border, 1 inside.
+
+    The border width derives from H only ("shortestDim",
+    `flyingChairsWrapFlow.py:763-765`).
+    """
+    bw = int(math.ceil(h * ratio))
+    m = jnp.zeros((h, w))
+    return m.at[bw : h - bw, bw : w - bw].set(1.0)
+
+
+def smoothness_mask_x(h: int, w: int) -> jnp.ndarray:
+    """(H, W) mask zeroing the last *column* (x-gradient invalid there)."""
+    return jnp.ones((h, w)).at[:, -1].set(0.0)
+
+
+def smoothness_mask_y(h: int, w: int) -> jnp.ndarray:
+    """(H, W) mask zeroing the last *row* (y-gradient invalid there)."""
+    return jnp.ones((h, w)).at[-1, :].set(0.0)
+
+
+def _edge_aware_masks(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sobel-based smoothness down-weighting near image edges.
+
+    Reference `version1/model/warpflow.py:93-117`: per-sample min-max
+    normalize to [0, 255], grayscale, Sobel x/y, normalize by global max
+    magnitude, mask = 1 - |grad|. Returns (mask_x, mask_y), each (B,H,W,1).
+    """
+    mn = jnp.min(inputs, axis=(1, 2, 3), keepdims=True)
+    mx = jnp.max(inputs, axis=(1, 2, 3), keepdims=True)
+    img = 255.0 * (inputs - mn) / jnp.maximum(mx - mn, 1e-12)
+    img = jnp.clip(jnp.floor(img), 0.0, 255.0)
+    gray = to_grayscale(img)
+    gx, gy = sobel_gradients(gray)
+    gx = gx / jnp.maximum(jnp.max(jnp.abs(gx)), 1e-12)
+    gy = gy / jnp.maximum(jnp.max(jnp.abs(gy)), 1e-12)
+    return 1.0 - jnp.abs(gx), 1.0 - jnp.abs(gy)
+
+
+def loss_interp(
+    flow: jnp.ndarray,
+    inputs: jnp.ndarray,
+    outputs: jnp.ndarray,
+    flow_scale: float,
+    cfg: LossConfig,
+    smooth_border_mask: bool = False,
+) -> tuple[LossDict, jnp.ndarray]:
+    """Two-frame photometric + smoothness loss at one pyramid scale.
+
+    flow: (B, h, w, 2) raw head output; inputs/outputs: (B, h, w, C)
+    LRN-normalized prev/next frames resized to this scale. Returns
+    (loss dict, reconstructed prev frame).
+    """
+    b, h, w, c = inputs.shape
+    scaled = flow * flow_scale
+    recon = backward_warp(outputs, scaled)
+
+    bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)
+    diff = 255.0 * (recon - inputs)
+    ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
+    num_valid = b * c * jnp.sum(bmask)
+    photo = jnp.sum(ele) / num_valid
+
+    sflow = scaled if cfg.smooth_scaled_flow else flow
+    mx = smoothness_mask_x(h, w)[None, :, :, None]
+    my = smoothness_mask_y(h, w)[None, :, :, None]
+
+    if cfg.smoothness == "canonical":
+        # x-diff of U masked at last col, y-diff of V masked at last row;
+        # optional border mask pre-Charbonnier (UCF variant).
+        du = forward_diff_x(sflow[..., 0:1]) * mx
+        dv = forward_diff_y(sflow[..., 1:2]) * my
+        if smooth_border_mask:
+            du = du * bmask[None, :, :, None]
+            dv = dv * bmask[None, :, :, None]
+        u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid
+        v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid
+    elif cfg.smoothness == "depthwise":
+        # both-direction gradients per component; border mask multiplies
+        # *after* the Charbonnier power; normalizer is 2/3 of the image one
+        # (`version1/model/warpflow.py:133-163`).
+        num_valid_flow = num_valid / 3.0 * 2.0
+        gx = forward_diff_x(sflow)  # (B,h,w,2): dU/dx, dV/dx
+        gy = forward_diff_y(sflow)
+        u_delta = jnp.stack([gx[..., 0] * mx[..., 0], gy[..., 0] * my[..., 0]], axis=-1)
+        v_delta = jnp.stack([gx[..., 1] * mx[..., 0], gy[..., 1] * my[..., 0]], axis=-1)
+        ele_u = charbonnier(u_delta, cfg.epsilon, cfg.alpha_s)
+        ele_v = charbonnier(v_delta, cfg.epsilon, cfg.alpha_s)
+        if cfg.edge_aware:
+            emx, emy = _edge_aware_masks(inputs)
+            emask = jnp.concatenate([emx, emy], axis=-1)  # (B,h,w,2)
+            ele_u = ele_u * emask
+            ele_v = ele_v * emask
+        bflow = bmask[None, :, :, None]
+        u_loss = jnp.sum(ele_u * bflow) / num_valid_flow
+        v_loss = jnp.sum(ele_v * bflow) / num_valid_flow
+    else:
+        raise ValueError(f"unknown smoothness variant {cfg.smoothness!r}")
+
+    total = photo + cfg.lambda_smooth * (u_loss + v_loss)
+    return (
+        {"total": total, "Charbonnier_reconstruct": photo,
+         "U_loss": u_loss, "V_loss": v_loss},
+        recon,
+    )
+
+
+def loss_interp_multi(
+    flows: jnp.ndarray,
+    volume: jnp.ndarray,
+    flow_scale: float,
+    cfg: LossConfig,
+) -> tuple[LossDict, jnp.ndarray]:
+    """T-frame volume loss (reference `sintelWrapFlow.py:492-630`).
+
+    flows: (B, h, w, 2*(T-1)) raw head output; volume: (B, h, w, 3*T)
+    LRN-normalized channel-stacked frames. Each consecutive pair (t, t+1) is
+    warped with its own flow pair; Charbonnier over all 3*(T-1) reconstructed
+    channels; smoothness per pair with both smoothness and border masks
+    applied pre-Charbonnier; U from even flow channels, V from odd.
+    """
+    b, h, w, c3t = volume.shape
+    t = c3t // 3
+    scaled = flows * flow_scale
+    recon = backward_warp_volume(volume, scaled)  # (B,h,w,3*(T-1))
+
+    bmask = border_mask(h, w, cfg.border_ratio)
+    diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])
+    ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
+    num_valid = b * 3 * (t - 1) * jnp.sum(bmask)
+    photo = jnp.sum(ele) / num_valid
+
+    sflow = scaled if cfg.smooth_scaled_flow else flows
+    mx = smoothness_mask_x(h, w)[None, :, :, None]
+    my = smoothness_mask_y(h, w)[None, :, :, None]
+    bflow = bmask[None, :, :, None]
+    du = forward_diff_x(sflow[..., 0::2]) * mx * bflow  # (B,h,w,T-1)
+    dv = forward_diff_y(sflow[..., 1::2]) * my * bflow
+    u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid
+    v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid
+
+    total = photo + cfg.lambda_smooth * (u_loss + v_loss)
+    return (
+        {"total": total, "Charbonnier_reconstruct": photo,
+         "U_loss": u_loss, "V_loss": v_loss},
+        recon,
+    )

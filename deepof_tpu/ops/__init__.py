@@ -1,0 +1,3 @@
+from .warp import backward_warp, backward_warp_volume  # noqa: F401
+from .lrn import local_response_normalization  # noqa: F401
+from .smoothness import forward_diff_x, forward_diff_y, sobel_gradients  # noqa: F401

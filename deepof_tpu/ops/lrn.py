@@ -1,0 +1,38 @@
+"""Local response normalization (ACROSS_CHANNELS).
+
+The reference normalizes the photometric-loss inputs with TF's LRN at
+depth_radius=4, beta=0.7, default bias=1, alpha=1
+(`flyingChairsWrapFlow.py:25-26`). Flax has no stock LRN; implemented
+directly:
+
+  out[..., d] = x[..., d] / (bias + alpha * sum_{i=d-r}^{d+r} x[..., i]^2) ** beta
+
+For 3-channel images and r=4 the window covers all channels, so the
+denominator is shared across channels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def local_response_normalization(
+    x: jnp.ndarray,
+    depth_radius: int = 4,
+    bias: float = 1.0,
+    alpha: float = 1.0,
+    beta: float = 0.7,
+) -> jnp.ndarray:
+    c = x.shape[-1]
+    sq = jnp.square(x)
+    if depth_radius >= c - 1:
+        window_sum = jnp.sum(sq, axis=-1, keepdims=True)
+    else:
+        # windowed channel sum via padded cumulative sum (static shapes)
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(depth_radius + 1, depth_radius)])
+        cs = jnp.cumsum(pad, axis=-1)
+        window_sum = (
+            jnp.take(cs, jnp.arange(c) + 2 * depth_radius + 1, axis=-1)
+            - jnp.take(cs, jnp.arange(c), axis=-1)
+        )
+    return x / jnp.power(bias + alpha * window_sum, beta)

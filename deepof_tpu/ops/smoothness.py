@@ -1,0 +1,81 @@
+"""Flow / image spatial-gradient helpers for the smoothness losses.
+
+The reference expresses these as 3x3 conv / depthwise-conv filters
+(`flyingChairsWrapFlow_vgg.py:52-59` flow_width/height filters,
+`flyingChairsWrapFlow.py:48` FlowDeltaWeights, sobel filters at
+`flyingChairsWrapFlow_vgg.py:63-69`). On TPU these are pure shift-subtract
+elementwise ops — cheaper than convolutions and fused by XLA.
+
+Conventions (match the intended filter semantics, cross-correlation with
+SAME zero padding):
+  forward_diff_x(f)[y, x] = f[y, x] - f[y, x+1]   (last column: f[y, x] - 0)
+  forward_diff_y(f)[y, x] = f[y, x] - f[y+1, x]   (last row:    f[y, x] - 0)
+
+Note: the reference's gen-2 `FlowDeltaWeights` constant supplies only 18 of
+the 36 values of its declared [3,3,2,2] shape; TF fills the remainder with
+the trailing zero, which silently distorts the filter (V channel unused and
+a diagonal difference on U). We implement the *intended* semantics — x-diff
+of U, y-diff of V — which is also what the reference's own depthwise-filter
+variants (`flyingChairsWrapFlow_vgg.py:52-59`, `version1/model/warpflow.py`)
+compute. Divergence documented here per SURVEY.md §7.3.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# TF rgb_to_grayscale weights, applied to channels as stored. The reference
+# feeds BGR images (cv2) through tf.image.rgb_to_grayscale
+# (`version1/model/warpflow.py:105`), so the weights land on swapped
+# channels; we reproduce that exact behavior.
+_GRAY_WEIGHTS = jnp.array([0.2989, 0.587, 0.114])
+
+
+def forward_diff_x(f: jnp.ndarray) -> jnp.ndarray:
+    """f[..., H, W, C] -> f - shift_left(f) with zero fill at the last column."""
+    shifted = jnp.pad(f[..., :, 1:, :], [(0, 0)] * (f.ndim - 3) + [(0, 0), (0, 1), (0, 0)])
+    return f - shifted
+
+
+def forward_diff_y(f: jnp.ndarray) -> jnp.ndarray:
+    """f[..., H, W, C] -> f - shift_up(f) with zero fill at the last row."""
+    shifted = jnp.pad(f[..., 1:, :, :], [(0, 0)] * (f.ndim - 3) + [(0, 1), (0, 0), (0, 0)])
+    return f - shifted
+
+
+def sobel_gradients(gray: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """3x3 Sobel x/y gradients of (B, H, W, 1), SAME zero padding.
+
+    Matches tf.nn.depthwise_conv2d with sobel_x = [[-1,0,1],[-2,0,2],[-1,0,1]]
+    and sobel_y = its transpose (`flyingChairsWrapFlow_vgg.py:63-69`),
+    expressed as shift-adds.
+    """
+
+    def shift(a, dy, dx):
+        a = a[..., 0]  # (B, H, W)
+        h, w = a.shape[-2:]
+        pad_y = (max(-dy, 0), max(dy, 0))
+        pad_x = (max(-dx, 0), max(dx, 0))
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 2) + [pad_y, pad_x])
+        y0 = max(dy, 0)
+        x0 = max(dx, 0)
+        return a[..., y0 : y0 + h, x0 : x0 + w][..., None]
+
+    # cross-correlation: out(y,x) = sum_k k(dy,dx) * in(y+dy-1, x+dx-1)
+    def cc(kernel):
+        out = 0.0
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                kv = kernel[dy + 1][dx + 1]
+                if kv:
+                    out = out + kv * shift(gray, dy, dx)
+        return out
+
+    sx = cc([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]])
+    sy = cc([[-1, -2, -1], [0, 0, 0], [1, 2, 1]])
+    return sx, sy
+
+
+def to_grayscale(img: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, 3) -> (B, H, W, 1) with TF grayscale weights."""
+    return jnp.tensordot(img, _GRAY_WEIGHTS, axes=[[-1], [0]])[..., None]

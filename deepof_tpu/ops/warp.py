@@ -1,0 +1,88 @@
+"""Bilinear backward warping — the core "kernel" op.
+
+Semantics match the reference's TF graph construction at
+`flyingChairsWrapFlow.py:785-838` exactly, but fully vectorized (one fused
+XLA gather instead of the reference's O(batch * channels) python-loop graph
+nodes):
+
+  - flow channel 0 = u = horizontal displacement (added to the x/width
+    coordinate), channel 1 = v = vertical (y/height);
+  - the *already scaled* flow is split into integer floor + fractional
+    weights;
+  - each of the four neighbor coordinates is clipped to the image border
+    independently (clip-at-border, NOT zero-fill outside);
+  - the four neighbors are blended bilinearly.
+
+`backward_warp(next_frame, flow)` returns the next frame warped backward to
+the previous frame's coordinates ("reconstructs" in the reference).
+
+TPU note: XLA lowers `jnp.take_along_axis` over the flattened H*W axis to a
+single dynamic-gather; the Pallas fused kernel in `ops/pallas/warp_loss.py`
+goes further and fuses warp + Charbonnier + masked reduction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _gather_hw(img_flat: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """img_flat: (B, H*W, C); idx: (B, H*W) int32 -> (B, H*W, C)."""
+    return jnp.take_along_axis(img_flat, idx[..., None], axis=1)
+
+
+def backward_warp(image: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
+    """Warp `image` (B, H, W, C) backward by `flow` (B, H, W, 2).
+
+    `flow` must already include any flow_scale factor (the caller applies it,
+    as the reference does at `flyingChairsWrapFlow.py:785`).
+    """
+    b, h, w, c = image.shape
+    img_flat = image.reshape(b, h * w, c)
+    flow_flat = flow.reshape(b, h * w, 2)
+
+    floor_flow = jnp.floor(flow_flat)
+    frac = flow_flat - floor_flow
+    fx = floor_flow[..., 0].astype(jnp.int32)  # u -> x offset
+    fy = floor_flow[..., 1].astype(jnp.int32)  # v -> y offset
+    wx = frac[..., 0][..., None]
+    wy = frac[..., 1][..., None]
+
+    # Flat pixel grid: x = column index, y = row index.
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.int32),
+                          jnp.arange(w, dtype=jnp.int32), indexing="ij")
+    pos_x = xs.reshape(-1)[None, :]  # (1, H*W)
+    pos_y = ys.reshape(-1)[None, :]
+
+    x0 = jnp.clip(pos_x + fx, 0, w - 1)
+    x1 = jnp.clip(pos_x + fx + 1, 0, w - 1)
+    y0 = jnp.clip(pos_y + fy, 0, h - 1)
+    y1 = jnp.clip(pos_y + fy + 1, 0, h - 1)
+
+    ia = _gather_hw(img_flat, y0 * w + x0)
+    ib = _gather_hw(img_flat, y1 * w + x0)
+    ic = _gather_hw(img_flat, y0 * w + x1)
+    id_ = _gather_hw(img_flat, y1 * w + x1)
+
+    out = (ia * (1 - wx) * (1 - wy) + ib * (1 - wx) * wy
+           + ic * wx * (1 - wy) + id_ * wx * wy)
+    return out.reshape(b, h, w, c)
+
+
+def backward_warp_volume(volume: jnp.ndarray, flows: jnp.ndarray) -> jnp.ndarray:
+    """Multi-frame warp (reference `sintelWrapFlow.py:539-577` semantics).
+
+    volume: (B, H, W, 3*T) channel-stacked frames; flows: (B, H, W, 2*(T-1)).
+    Reconstructs frame t from frame t+1 using flow pair t, for t in [0, T-1):
+    returns (B, H, W, 3*(T-1)) — channel c is gathered from volume channel
+    c+3 using flow channels (2*(c//3), 2*(c//3)+1).
+    """
+    b, h, w, c3t = volume.shape
+    t = c3t // 3
+    frames = volume.reshape(b, h, w, t, 3)
+    pairs = flows.reshape(b, h, w, t - 1, 2)
+    # fold the pair axis into batch: warp all (T-1) next-frames at once
+    nxt = jnp.moveaxis(frames[..., 1:, :], 3, 1).reshape(b * (t - 1), h, w, 3)
+    flw = jnp.moveaxis(pairs, 3, 1).reshape(b * (t - 1), h, w, 2)
+    rec = backward_warp(nxt, flw).reshape(b, t - 1, h, w, 3)
+    return jnp.moveaxis(rec, 1, 3).reshape(b, h, w, 3 * (t - 1))

@@ -22,7 +22,7 @@ def flow_epe(pred, gt, mask=None):
     ee = np.sqrt(d[..., 0] ** 2 + d[..., 1] ** 2)
     if mask is None:
         return ee.mean()
-    mask = np.asarray(mask, dtype=ee.dtype)
+    mask = np.broadcast_to(np.asarray(mask, dtype=ee.dtype), ee.shape)
     return (ee * mask).sum() / np.maximum(mask.sum(), 1)
 
 
@@ -38,5 +38,5 @@ def flow_aae(pred, gt, mask=None):
     ae = np.arccos(np.clip(num / den, -1.0, 1.0))
     if mask is None:
         return ae.mean()
-    mask = np.asarray(mask, dtype=ae.dtype)
+    mask = np.broadcast_to(np.asarray(mask, dtype=ae.dtype), ae.shape)
     return (ae * mask).sum() / np.maximum(mask.sum(), 1)

@@ -1,15 +1,27 @@
-"""Test harness: run everything on a virtual 8-device CPU mesh.
+"""Test harness: run the suite on a virtual 8-device CPU mesh.
 
-Must set env vars before jax is imported anywhere (SURVEY.md §4: multi-device
-tests via host-platform device-count simulation).
+Multi-device tests follow SURVEY.md §4: simulate a mesh with
+`--xla_force_host_platform_device_count=8` on CPU.
+
+The container's sitecustomize registers an `axon` TPU backend in every
+interpreter *before* pytest starts, and initializing it from a second
+process can hang on the device tunnel. jax is therefore already imported by
+the time this conftest runs; switching platforms must go through
+`jax.config` and the backend-factory registry, not env vars alone.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._backend_factories.pop("axon", None)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,166 @@
+"""Loss-layer tests: masks, Charbonnier normalization, smoothness variants,
+multi-frame volume loss, pyramid orchestration, LRN."""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepof_tpu.core.config import LossConfig
+from deepof_tpu.losses import (
+    border_mask,
+    charbonnier,
+    loss_interp,
+    loss_interp_multi,
+    pyramid_loss,
+)
+from deepof_tpu.losses.pyramid import lrn_normalize, preprocess
+from deepof_tpu.ops import local_response_normalization
+
+
+def test_border_mask():
+    m = np.asarray(border_mask(20, 30, 0.1))
+    bw = math.ceil(20 * 0.1)
+    assert m[:bw].sum() == 0 and m[:, :bw].sum() == 0
+    assert m[bw : 20 - bw, bw : 30 - bw].all()
+    assert m.sum() == (20 - 2 * bw) * (30 - 2 * bw)
+
+
+def test_charbonnier():
+    out = np.asarray(charbonnier(jnp.asarray([3.0]), 1e-4, 0.5))
+    assert np.isclose(out[0], np.sqrt(9 + 1e-8))
+
+
+def test_lrn_matches_tf_formula(rng):
+    """LRN vs direct per-channel windowed-sum formula (r=4, beta=0.7)."""
+    x = rng.randn(2, 4, 5, 3).astype(np.float32)
+    got = np.asarray(local_response_normalization(jnp.asarray(x)))
+    sq = x**2
+    want = x / (1.0 + sq.sum(-1, keepdims=True)) ** 0.7  # r=4 >= C: full window
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lrn_windowed(rng):
+    """r < C-1 path: windowed channel sums."""
+    x = rng.randn(1, 2, 2, 8).astype(np.float32)
+    got = np.asarray(local_response_normalization(jnp.asarray(x), depth_radius=2))
+    for d in range(8):
+        lo, hi = max(0, d - 2), min(8, d + 3)
+        win = (x[..., lo:hi] ** 2).sum(-1)
+        np.testing.assert_allclose(got[..., d], x[..., d] / (1 + win) ** 0.7, rtol=1e-5)
+
+
+def _loss_cfg(**kw):
+    base = dict(epsilon=1e-4, alpha_c=0.25, alpha_s=0.37, lambda_smooth=1.0)
+    base.update(kw)
+    return LossConfig(**base)
+
+
+def test_perfect_reconstruction_low_photo_loss(rng):
+    """Identical frames + zero flow -> photometric loss == charb(0) masked mean
+    == (eps^2)^alpha_c, and zero-flow smoothness == (eps^2)^alpha_s terms."""
+    img = jnp.asarray(rng.rand(2, 12, 16, 3).astype(np.float32))
+    flow = jnp.zeros((2, 12, 16, 2))
+    cfg = _loss_cfg()
+    ld, recon = loss_interp(flow, img, img, 1.0, cfg)
+    eps_term = (1e-4**2) ** 0.25
+    assert np.isclose(float(ld["Charbonnier_reconstruct"]), eps_term, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(recon), np.asarray(img), rtol=1e-6)
+
+
+def test_photo_loss_increases_with_mismatch(rng):
+    img1 = jnp.asarray(rng.rand(1, 12, 16, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(1, 12, 16, 3).astype(np.float32))
+    flow = jnp.zeros((1, 12, 16, 2))
+    cfg = _loss_cfg()
+    ld_same, _ = loss_interp(flow, img1, img1, 1.0, cfg)
+    ld_diff, _ = loss_interp(flow, img1, img2, 1.0, cfg)
+    assert float(ld_diff["Charbonnier_reconstruct"]) > float(ld_same["Charbonnier_reconstruct"])
+
+
+def test_smoothness_penalizes_rough_flow(rng):
+    img = jnp.asarray(rng.rand(1, 12, 16, 3).astype(np.float32))
+    smooth_flow = jnp.ones((1, 12, 16, 2))
+    rough = jnp.asarray(rng.randn(1, 12, 16, 2).astype(np.float32) * 5)
+    cfg = _loss_cfg()
+    ld_s, _ = loss_interp(smooth_flow, img, img, 1.0, cfg)
+    ld_r, _ = loss_interp(rough, img, img, 1.0, cfg)
+    assert float(ld_r["U_loss"] + ld_r["V_loss"]) > float(ld_s["U_loss"] + ld_s["V_loss"])
+    # constant flow has zero gradient inside masks: every one of the H*W
+    # cells contributes the (eps^2)^alpha_s floor, normalized by the
+    # *image* valid count B*C*interior (reference normalization).
+    eps_floor = (1e-4**2) ** 0.37
+    interior = (12 - 2 * 2) * (16 - 2 * 2)
+    want = eps_floor * 12 * 16 / (3 * interior)
+    assert np.isclose(float(ld_s["U_loss"]), want, rtol=1e-3)
+
+
+def test_depthwise_variant_runs(rng):
+    img = jnp.asarray(rng.rand(2, 12, 16, 3).astype(np.float32))
+    flow = jnp.asarray(rng.randn(2, 12, 16, 2).astype(np.float32))
+    cfg = _loss_cfg(smoothness="depthwise")
+    ld, _ = loss_interp(flow, img, img, 2.0, cfg)
+    for k in ("total", "Charbonnier_reconstruct", "U_loss", "V_loss"):
+        assert np.isfinite(float(ld[k]))
+
+
+def test_edge_aware_reduces_smoothness(rng):
+    """Edge-aware weighting can only shrink the smoothness integrand."""
+    img = jnp.asarray(rng.rand(1, 12, 16, 3).astype(np.float32))
+    flow = jnp.asarray(rng.randn(1, 12, 16, 2).astype(np.float32) * 3)
+    plain, _ = loss_interp(flow, img, img, 1.0, _loss_cfg(smoothness="depthwise"))
+    edge, _ = loss_interp(flow, img, img, 1.0, _loss_cfg(smoothness="depthwise", edge_aware=True))
+    assert float(edge["U_loss"]) <= float(plain["U_loss"]) + 1e-9
+    assert float(edge["V_loss"]) <= float(plain["V_loss"]) + 1e-9
+
+
+def test_multi_frame_matches_stacked_two_frame(rng):
+    """For T=2 the volume loss photometric term must equal the 2-frame one."""
+    b, h, w = 1, 12, 16
+    img1 = rng.rand(b, h, w, 3).astype(np.float32)
+    img2 = rng.rand(b, h, w, 3).astype(np.float32)
+    flow = (rng.rand(b, h, w, 2).astype(np.float32) - 0.5) * 4
+    cfg = _loss_cfg()
+    vol = jnp.asarray(np.concatenate([img1, img2], axis=-1))
+    ld_multi, rec_m = loss_interp_multi(jnp.asarray(flow), vol, 1.5, cfg)
+    ld_two, rec_t = loss_interp(jnp.asarray(flow), jnp.asarray(img1), jnp.asarray(img2), 1.5, cfg)
+    assert np.isclose(float(ld_multi["Charbonnier_reconstruct"]),
+                      float(ld_two["Charbonnier_reconstruct"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec_m), np.asarray(rec_t), rtol=1e-5)
+
+
+def test_multi_frame_t10_shapes(rng):
+    b, h, w, t = 1, 12, 16, 10
+    vol = jnp.asarray(rng.rand(b, h, w, 3 * t).astype(np.float32))
+    flows = jnp.asarray(rng.randn(b, h, w, 2 * (t - 1)).astype(np.float32))
+    ld, recon = loss_interp_multi(flows, vol, 1.0, _loss_cfg())
+    assert recon.shape == (b, h, w, 3 * (t - 1))
+    assert np.isfinite(float(ld["total"]))
+
+
+def test_pyramid_loss_weighting(rng):
+    """Weighted total = sum w_k * total_k, finest first."""
+    b = 1
+    inp = jnp.asarray(rng.rand(b, 16, 24, 3).astype(np.float32))
+    out = jnp.asarray(rng.rand(b, 16, 24, 3).astype(np.float32))
+    pyr = [
+        (jnp.asarray(rng.randn(b, 16, 24, 2).astype(np.float32)), 10.0),
+        (jnp.asarray(rng.randn(b, 8, 12, 2).astype(np.float32)), 5.0),
+        (jnp.asarray(rng.randn(b, 4, 6, 2).astype(np.float32)), 2.5),
+    ]
+    cfg = _loss_cfg(weights=(16, 8, 4))
+    total, losses, recon = pyramid_loss(pyr, inp, out, cfg)
+    want = 16 * losses[0]["total"] + 8 * losses[1]["total"] + 4 * losses[2]["total"]
+    assert np.isclose(float(total), float(want), rtol=1e-6)
+    assert recon.shape == (b, 16, 24, 3)
+
+
+def test_preprocess_and_lrn(rng):
+    img = jnp.asarray(rng.rand(1, 8, 8, 3).astype(np.float32) * 255)
+    mean = [97.533, 99.238, 97.056]
+    scaled = preprocess(img, mean)
+    assert float(jnp.max(jnp.abs(scaled))) <= 1.0
+    norm = lrn_normalize(scaled)
+    assert norm.shape == scaled.shape
+    # LRN shrinks magnitudes (denominator >= 1)
+    assert float(jnp.max(jnp.abs(norm))) <= float(jnp.max(jnp.abs(scaled))) + 1e-6

@@ -57,3 +57,14 @@ def test_flow_to_color():
 def test_flow_to_color_zero_flow_is_white():
     img = flow_to_color(np.zeros((4, 4, 2)))
     assert (img >= 250).all()
+
+
+def test_epe_broadcast_mask():
+    """(H,W) mask shared across a batch must not inflate the metric."""
+    gt = np.zeros((4, 2, 2, 2))
+    pred = np.zeros((4, 2, 2, 2))
+    pred[..., 0] = 3.0
+    pred[..., 1] = 4.0
+    mask = np.ones((2, 2))
+    assert np.isclose(flow_epe(pred, gt, mask), 5.0)
+    assert np.isclose(flow_aae(pred, gt, mask), flow_aae(pred, gt))
