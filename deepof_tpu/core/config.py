@@ -175,6 +175,14 @@ UCF101 = ExperimentConfig(
     train=TrainConfig(num_epochs=1000, eval_amplifier=1.0, eval_clip=(-1e9, 1e9)),
 )
 
+# gen-1 per-model loss-weight alternates (`version1/trainOF.py:76-87`),
+# selectable via LossConfig.weights overrides.
+GEN1_LOSS_WEIGHTS = {
+    "vgg16": (7.0, 5.0, 3.0, 3.0, 1.0),
+    "flownet_s": (9.0, 7.0, 5.0, 3.0, 3.0, 1.0),
+    "inception_v3": (9.0, 7.0, 5.0, 3.0, 3.0, 1.0),
+}
+
 PRESETS: dict[str, ExperimentConfig] = {
     "flyingchairs": FLYINGCHAIRS,
     "flyingchairs_vgg": FLYINGCHAIRS_VGG,

@@ -109,7 +109,12 @@ def loss_interp(
     bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)
     diff = 255.0 * (recon - inputs)
     ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
-    num_valid = b * c * jnp.sum(bmask)
+    # guard: at very coarse pyramid levels (h <= 2) the border mask has no
+    # interior (the reference never ran levels this small); such a level
+    # contributes exactly 0 to photometric AND smoothness terms.
+    n_interior = jnp.sum(bmask)
+    level_on = (n_interior > 0).astype(ele.dtype)
+    num_valid = jnp.maximum(b * c * n_interior, 1.0)
     photo = jnp.sum(ele) / num_valid
 
     sflow = scaled if cfg.smooth_scaled_flow else flow
@@ -148,6 +153,8 @@ def loss_interp(
     else:
         raise ValueError(f"unknown smoothness variant {cfg.smoothness!r}")
 
+    u_loss = u_loss * level_on
+    v_loss = v_loss * level_on
     total = photo + cfg.lambda_smooth * (u_loss + v_loss)
     return (
         {"total": total, "Charbonnier_reconstruct": photo,
@@ -178,7 +185,9 @@ def loss_interp_multi(
     bmask = border_mask(h, w, cfg.border_ratio)
     diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])
     ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
-    num_valid = b * 3 * (t - 1) * jnp.sum(bmask)
+    n_interior = jnp.sum(bmask)
+    level_on = (n_interior > 0).astype(ele.dtype)
+    num_valid = jnp.maximum(b * 3 * (t - 1) * n_interior, 1.0)
     photo = jnp.sum(ele) / num_valid
 
     sflow = scaled if cfg.smooth_scaled_flow else flows
@@ -187,8 +196,8 @@ def loss_interp_multi(
     bflow = bmask[None, :, :, None]
     du = forward_diff_x(sflow[..., 0::2]) * mx * bflow  # (B,h,w,T-1)
     dv = forward_diff_y(sflow[..., 1::2]) * my * bflow
-    u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid
-    v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid
+    u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid * level_on
+    v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid * level_on
 
     total = photo + cfg.lambda_smooth * (u_loss + v_loss)
     return (

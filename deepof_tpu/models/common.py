@@ -1,0 +1,175 @@
+"""Shared model building blocks.
+
+Conventions (matching the reference's slim usage unless noted):
+  - NHWC layout, SAME padding everywhere;
+  - encoder/decoder convs use ELU activation in the flow models
+    (`flyingChairsWrapFlow.py:28-29` arg_scope) except prediction (`pr*`) and
+    flow-upsampling (`up_pr*`) layers which are linear;
+  - conv kernels init with glorot-uniform (slim xavier default), zero biases;
+  - transposed convs are 2*scale x 2*scale kernels with stride=scale;
+    feature deconvs can be initialized to bilinear upsampling with identity
+    channel mapping, the reference's `load_deconv_weights` behavior
+    (`flyingChairsTrain.py:78-92`) expressed as a flax initializer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+conv_init = nn.initializers.glorot_uniform()
+
+Dtype = Any
+
+
+def bilinear_upsample_kernel(kh: int, kw: int) -> np.ndarray:
+    """(kh, kw) bilinear interpolation kernel (max 1 at the center)."""
+    def axis(k):
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        return 1 - np.abs(np.arange(k) / f - c)
+
+    return np.outer(axis(kh), axis(kw))
+
+
+def bilinear_kernel_init(key, shape, dtype=jnp.float32):
+    """flax ConvTranspose kernel initializer: bilinear upsampling, identity
+    across channels (zero between different in/out channels).
+
+    shape = (kh, kw, in_features, out_features).
+    """
+    del key
+    kh, kw, cin, cout = shape
+    up = bilinear_upsample_kernel(kh, kw)
+    k = np.zeros(shape, np.float32)
+    for c in range(min(cin, cout)):
+        k[:, :, c, c] = up
+    return jnp.asarray(k, dtype)
+
+
+class ConvELU(nn.Module):
+    """3x3-style conv + ELU (slim conv2d with elu activation)."""
+
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    stride: int = 1
+    act: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, strides=(self.stride, self.stride),
+                    padding="SAME", kernel_init=conv_init, dtype=self.dtype)(x)
+        return nn.elu(x) if self.act else x
+
+
+class Deconv(nn.Module):
+    """Transposed conv, kernel (2*scale, 2*scale), stride=scale.
+
+    `bilinear_init=True` reproduces the reference's bilinear-upsampling
+    initialization of the `upconv*`/`up_pr*` weights.
+    """
+
+    features: int
+    scale: int = 2
+    act: bool = True
+    bilinear_init: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k = 2 * self.scale
+        init = bilinear_kernel_init if self.bilinear_init else conv_init
+        x = nn.ConvTranspose(self.features, (k, k),
+                             strides=(self.scale, self.scale), padding="SAME",
+                             kernel_init=init, dtype=self.dtype)(x)
+        return nn.elu(x) if self.act else x
+
+
+class FlowDecoder(nn.Module):
+    """Generic multi-scale flow decoder (the pattern shared by every model:
+    `flyingChairsWrapFlow.py:60-118`, `:689-739`, `:527-584`).
+
+    Consumes encoder features coarsest-first. At each level k:
+        pr_k    = 3x3 linear conv -> flow_channels
+        feat    = concat(skip_{k-1}, Deconv(feat), Deconv_linear(pr_k))
+    Levels may have per-level deconv scale (the Inception head uses scale=1
+    between two same-resolution taps, `flyingChairsWrapFlow.py:551-556`).
+
+    Returns flows coarsest-first; callers reverse to finest-first.
+    """
+
+    upconv_features: Sequence[int]  # feature deconv widths, one per transition
+    scales: Sequence[int] | None = None  # deconv scales per transition (default 2)
+    flow_channels: int = 2
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, feats_coarse_first: Sequence[jnp.ndarray]) -> list[jnp.ndarray]:
+        n = len(feats_coarse_first)
+        scales = self.scales or [2] * (n - 1)
+        assert len(self.upconv_features) == n - 1 and len(scales) == n - 1
+        flows = []
+        feat = feats_coarse_first[0]
+        for k in range(n - 1):
+            pr = ConvELU(self.flow_channels, act=False, dtype=self.dtype,
+                         name=f"pr{n - k}")(feat)
+            flows.append(pr)
+            up_feat = Deconv(self.upconv_features[k], scale=scales[k],
+                             dtype=self.dtype, name=f"upconv{n - k - 1}")(feat)
+            up_pr = Deconv(self.flow_channels, scale=scales[k], act=False,
+                           dtype=self.dtype,
+                           name=f"up_pr{n - k}to{n - k - 1}")(pr)
+            # odd skip sizes: stride-2 deconvs overshoot by one — crop to the
+            # skip resolution (standard FlowNet practice; the reference only
+            # ever ran /64-divisible sizes and never hit this)
+            skip = feats_coarse_first[k + 1]
+            sh, sw = skip.shape[1:3]
+            up_feat = up_feat[:, :sh, :sw]
+            up_pr = up_pr[:, :sh, :sw]
+            feat = jnp.concatenate([skip, up_feat, up_pr], axis=-1)
+        flows.append(ConvELU(self.flow_channels, act=False, dtype=self.dtype,
+                             name="pr1")(feat))
+        return flows
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def load_vgg16_npz(
+    params: dict,
+    npz_path: str,
+    trunk_path: Sequence[str] = ("encoder",),
+    duplicate_input: bool = True,
+) -> dict:
+    """Initialize VGG16 trunk params from the public `vgg16_weights.npz`.
+
+    Reference behavior (`flyingChairsTrain.py:60-76`): the 13 conv layers'
+    weights are assigned in order; the first conv's filters are tiled x2
+    along in-channels for the 6-channel (image-pair) input; fc layers are
+    skipped. No download is attempted (zero-egress); callers must provide
+    the file.
+    """
+    data = np.load(npz_path)
+    new = jax.tree_util.tree_map(lambda x: x, params)  # rebuilt pytree, safe to mutate
+
+    sub = new
+    for p in trunk_path:
+        sub = sub[p]
+
+    names = [f"conv{b}_{i}" for b, n in zip(range(1, 6), (2, 2, 3, 3, 3))
+             for i in range(1, n + 1)]
+    for name in names:
+        w, bias = data[f"{name}_W"], data[f"{name}_b"]
+        if name == "conv1_1" and duplicate_input and sub[name]["Conv_0"]["kernel"].shape[2] == 2 * w.shape[2]:
+            w = np.concatenate([w, w], axis=2)
+        tgt = sub[name]["Conv_0"]
+        assert tgt["kernel"].shape == w.shape, (name, tgt["kernel"].shape, w.shape)
+        tgt["kernel"] = jnp.asarray(w)
+        tgt["bias"] = jnp.asarray(bias)
+    return new

@@ -1,0 +1,62 @@
+"""FlowNet-Correlation flow model — new capability (BASELINE.json configs;
+no reference implementation; architecture from the FlowNet paper,
+arXiv:1504.06852 §3, adapted to this framework's SAME-padded ELU style).
+
+Two siamese conv1..conv3 towers over each (preprocessed) frame, a
+multiplicative correlation cost volume (max displacement 20, stride 2 ->
+441 maps), a 1x1 `conv_redir` (32ch) of the first tower, then the FlowNet-S
+contracting/expanding tail with 6 pyramid heads (same flow scales).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.corr import correlation
+from .common import ConvELU, FlowDecoder
+from .flownet_s import FLOW_SCALES
+
+
+class FlowNetC(nn.Module):
+    flow_channels: int = 2
+    max_disp: int = 20
+    corr_stride: int = 2
+    dtype: Any = jnp.float32
+
+    flow_scales: tuple[float, ...] = FLOW_SCALES
+
+    @nn.compact
+    def __call__(self, pair: jnp.ndarray) -> list[jnp.ndarray]:
+        dt = self.dtype
+        img1, img2 = pair[..., :3], pair[..., 3:]
+
+        conv1 = ConvELU(64, (7, 7), 2, dtype=dt, name="conv1")
+        conv2 = ConvELU(128, (5, 5), 2, dtype=dt, name="conv2")
+        conv3 = ConvELU(256, (5, 5), 2, dtype=dt, name="conv3")
+        c1 = conv1(img1)
+        c2 = conv2(c1)
+        f1 = conv3(c2)
+        f2 = conv3(conv2(conv1(img2)))  # siamese: same modules, shared weights
+
+        corr = nn.elu(correlation(f1, f2, self.max_disp, self.corr_stride))
+        redir = ConvELU(32, (1, 1), dtype=dt, name="conv_redir")(f1)
+        net = jnp.concatenate([corr, redir], axis=-1)
+
+        conv3_1 = ConvELU(256, dtype=dt, name="conv3_1")(net)
+        conv4_1 = ConvELU(512, stride=2, dtype=dt, name="conv4_1")(conv3_1)
+        conv4_2 = ConvELU(512, dtype=dt, name="conv4_2")(conv4_1)
+        conv5_1 = ConvELU(512, stride=2, dtype=dt, name="conv5_1")(conv4_2)
+        conv5_2 = ConvELU(512, dtype=dt, name="conv5_2")(conv5_1)
+        conv6_1 = ConvELU(1024, stride=2, dtype=dt, name="conv6_1")(conv5_2)
+        conv6_2 = ConvELU(1024, dtype=dt, name="conv6_2")(conv6_1)
+
+        flows = FlowDecoder(
+            upconv_features=(512, 256, 128, 64, 32),
+            flow_channels=self.flow_channels,
+            dtype=dt,
+            name="decoder",
+        )([conv6_2, conv5_2, conv4_2, conv3_1, c2, c1])
+        return flows[::-1]

@@ -1,0 +1,51 @@
+"""FlowNet-Simple flow model.
+
+Architecture parity with reference `flowNet` (`flyingChairsWrapFlow.py:31-118`):
+10-conv contracting trunk (strides 2 at conv1/2/3_1/4_1/5_1/6_1), ELU
+activations, 6 pyramid heads with flow scales 20/2^k (finest pr1 scale 10.0
+... coarsest pr6 scale 0.3125), decoder deconvs of widths 512/256/128/64/32.
+
+Input: preprocessed image pair concatenated on channels (B, H, W, 6) — or a
+(B, H, W, 3T) multi-frame volume with `flow_channels=2(T-1)`.
+Output: list of flow predictions finest-first; `flow_scales` finest-first.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .common import ConvELU, FlowDecoder
+
+FLOW_SCALES = (10.0, 5.0, 2.5, 1.25, 0.625, 0.3125)  # finest (pr1) first
+
+
+class FlowNetS(nn.Module):
+    flow_channels: int = 2
+    dtype: Any = jnp.float32
+
+    flow_scales: tuple[float, ...] = FLOW_SCALES
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
+        dt = self.dtype
+        conv1 = ConvELU(64, (7, 7), 2, dtype=dt, name="conv1")(x)
+        conv2 = ConvELU(128, (5, 5), 2, dtype=dt, name="conv2")(conv1)
+        conv3_1 = ConvELU(256, (5, 5), 2, dtype=dt, name="conv3_1")(conv2)
+        conv3_2 = ConvELU(256, dtype=dt, name="conv3_2")(conv3_1)
+        conv4_1 = ConvELU(512, stride=2, dtype=dt, name="conv4_1")(conv3_2)
+        conv4_2 = ConvELU(512, dtype=dt, name="conv4_2")(conv4_1)
+        conv5_1 = ConvELU(512, stride=2, dtype=dt, name="conv5_1")(conv4_2)
+        conv5_2 = ConvELU(512, dtype=dt, name="conv5_2")(conv5_1)
+        conv6_1 = ConvELU(1024, stride=2, dtype=dt, name="conv6_1")(conv5_2)
+        conv6_2 = ConvELU(1024, dtype=dt, name="conv6_2")(conv6_1)
+
+        flows = FlowDecoder(
+            upconv_features=(512, 256, 128, 64, 32),
+            flow_channels=self.flow_channels,
+            dtype=dt,
+            name="decoder",
+        )([conv6_2, conv5_2, conv4_2, conv3_2, conv2, conv1])
+        return flows[::-1]  # finest first

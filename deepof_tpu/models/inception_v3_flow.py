@@ -1,0 +1,205 @@
+"""Inception-v3-backbone flow model — the reference's flagship trainer model
+(`flyingChairsTrain.py:103`, `sintelTrain.py:112`).
+
+Base: standard Inception-v3 with *all-SAME* padding (the reference edited
+slim's base so every stage halves cleanly, `flyingChairsWrapFlow.py:145-467`)
+and slim-default ReLU activations — the trainers call the model without the
+batch-norm arg-scope, so the base has conv+bias only, no normalization.
+
+Head (`flyingChairsWrapFlow.py:471-595`): 6 pyramid levels tapped at
+Conv2d_1a_3x3 / MaxPool_3a_3x3 / MaxPool_5a_3x3 / Mixed_5d / Mixed_6e /
+Mixed_7c, ELU decoder deconvs of widths 512/256/128/64/32, and a stride-1
+2x2 deconv between the Mixed_5d and MaxPool_5a taps because they share a
+spatial size (`:551-556`). Flow scales finest-first:
+10 / 5 / 2.5 / 2.5 / 1.25 / 0.625 — note the repeated 2.5.
+
+Multi-frame Sintel volumes (`sintelWrapFlow.py:342-453`) use the same
+architecture with `flow_channels=2*(T-1)`; unlike the reference, the decoder
+propagates *all* flow channels through `up_pr*` (the reference's 2-channel
+truncation is a known bug per SURVEY.md §7.3, not replicated).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .common import FlowDecoder, conv_init
+
+FLOW_SCALES = (10.0, 5.0, 2.5, 2.5, 1.25, 0.625)  # finest (pr1) first
+
+
+class _Conv(nn.Module):
+    """conv + bias + ReLU, SAME padding (slim default in the base)."""
+
+    features: int
+    kernel: tuple[int, int] = (1, 1)
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, strides=(self.stride, self.stride),
+                    padding="SAME", kernel_init=conv_init, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _avg_pool(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+def _max_pool(x, stride=2):
+    return nn.max_pool(x, (3, 3), strides=(stride, stride), padding="SAME")
+
+
+class _InceptionA(nn.Module):
+    """Mixed_5b/5c/5d: 1x1 + 5x5 + double-3x3 + pool-proj branches."""
+
+    pool_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.dtype
+        b0 = _Conv(64, dtype=dt, name="b0_1x1")(x)
+        b1 = _Conv(48, dtype=dt, name="b1_1x1")(x)
+        b1 = _Conv(64, (5, 5), dtype=dt, name="b1_5x5")(b1)
+        b2 = _Conv(64, dtype=dt, name="b2_1x1")(x)
+        b2 = _Conv(96, (3, 3), dtype=dt, name="b2_3x3a")(b2)
+        b2 = _Conv(96, (3, 3), dtype=dt, name="b2_3x3b")(b2)
+        b3 = _Conv(self.pool_features, dtype=dt, name="b3_proj")(_avg_pool(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _ReductionA(nn.Module):
+    """Mixed_6a: stride-2 reduction to 768."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.dtype
+        b0 = _Conv(384, (3, 3), 2, dtype=dt, name="b0_3x3")(x)
+        b1 = _Conv(64, dtype=dt, name="b1_1x1")(x)
+        b1 = _Conv(96, (3, 3), dtype=dt, name="b1_3x3a")(b1)
+        b1 = _Conv(96, (3, 3), 2, dtype=dt, name="b1_3x3b")(b1)
+        return jnp.concatenate([b0, b1, _max_pool(x)], axis=-1)
+
+
+class _InceptionB(nn.Module):
+    """Mixed_6b..6e: factorized 7x7 branches, 768 out."""
+
+    mid: int  # 128 / 160 / 192
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt, m = self.dtype, self.mid
+        b0 = _Conv(192, dtype=dt, name="b0_1x1")(x)
+        b1 = _Conv(m, dtype=dt, name="b1_1x1")(x)
+        b1 = _Conv(m, (1, 7), dtype=dt, name="b1_1x7")(b1)
+        b1 = _Conv(192, (7, 1), dtype=dt, name="b1_7x1")(b1)
+        b2 = _Conv(m, dtype=dt, name="b2_1x1")(x)
+        b2 = _Conv(m, (7, 1), dtype=dt, name="b2_7x1a")(b2)
+        b2 = _Conv(m, (1, 7), dtype=dt, name="b2_1x7a")(b2)
+        b2 = _Conv(m, (7, 1), dtype=dt, name="b2_7x1b")(b2)
+        b2 = _Conv(192, (1, 7), dtype=dt, name="b2_1x7b")(b2)
+        b3 = _Conv(192, dtype=dt, name="b3_proj")(_avg_pool(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class _ReductionB(nn.Module):
+    """Mixed_7a: stride-2 reduction to 1280."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.dtype
+        b0 = _Conv(192, dtype=dt, name="b0_1x1")(x)
+        b0 = _Conv(320, (3, 3), 2, dtype=dt, name="b0_3x3")(b0)
+        b1 = _Conv(192, dtype=dt, name="b1_1x1")(x)
+        b1 = _Conv(192, (1, 7), dtype=dt, name="b1_1x7")(b1)
+        b1 = _Conv(192, (7, 1), dtype=dt, name="b1_7x1")(b1)
+        b1 = _Conv(192, (3, 3), 2, dtype=dt, name="b1_3x3")(b1)
+        return jnp.concatenate([b0, b1, _max_pool(x)], axis=-1)
+
+
+class _InceptionC(nn.Module):
+    """Mixed_7b/7c: expanded-filter-bank blocks, 2048 out."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.dtype
+        b0 = _Conv(320, dtype=dt, name="b0_1x1")(x)
+        b1 = _Conv(384, dtype=dt, name="b1_1x1")(x)
+        b1 = jnp.concatenate(
+            [_Conv(384, (1, 3), dtype=dt, name="b1_1x3")(b1),
+             _Conv(384, (3, 1), dtype=dt, name="b1_3x1")(b1)], axis=-1)
+        b2 = _Conv(448, dtype=dt, name="b2_1x1")(x)
+        b2 = _Conv(384, (3, 3), dtype=dt, name="b2_3x3")(b2)
+        b2 = jnp.concatenate(
+            [_Conv(384, (1, 3), dtype=dt, name="b2_1x3")(b2),
+             _Conv(384, (3, 1), dtype=dt, name="b2_3x1")(b2)], axis=-1)
+        b3 = _Conv(192, dtype=dt, name="b3_proj")(_avg_pool(x))
+        return jnp.concatenate([b0, b1, b2, b3], axis=-1)
+
+
+class InceptionV3Base(nn.Module):
+    """Stem + Mixed blocks; returns the 6 decoder tap activations."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x) -> dict[str, jnp.ndarray]:
+        dt = self.dtype
+        taps = {}
+        net = _Conv(32, (3, 3), 2, dtype=dt, name="Conv2d_1a_3x3")(x)
+        taps["Conv2d_1a_3x3"] = net
+        net = _Conv(32, (3, 3), dtype=dt, name="Conv2d_2a_3x3")(net)
+        net = _Conv(64, (3, 3), dtype=dt, name="Conv2d_2b_3x3")(net)
+        net = _max_pool(net)
+        taps["MaxPool_3a_3x3"] = net
+        net = _Conv(80, dtype=dt, name="Conv2d_3b_1x1")(net)
+        net = _Conv(192, (3, 3), dtype=dt, name="Conv2d_4a_3x3")(net)
+        net = _max_pool(net)
+        taps["MaxPool_5a_3x3"] = net
+        net = _InceptionA(32, dtype=dt, name="Mixed_5b")(net)
+        net = _InceptionA(64, dtype=dt, name="Mixed_5c")(net)
+        net = _InceptionA(64, dtype=dt, name="Mixed_5d")(net)
+        taps["Mixed_5d"] = net
+        net = _ReductionA(dtype=dt, name="Mixed_6a")(net)
+        net = _InceptionB(128, dtype=dt, name="Mixed_6b")(net)
+        net = _InceptionB(160, dtype=dt, name="Mixed_6c")(net)
+        net = _InceptionB(160, dtype=dt, name="Mixed_6d")(net)
+        net = _InceptionB(192, dtype=dt, name="Mixed_6e")(net)
+        taps["Mixed_6e"] = net
+        net = _ReductionB(dtype=dt, name="Mixed_7a")(net)
+        net = _InceptionC(dtype=dt, name="Mixed_7b")(net)
+        net = _InceptionC(dtype=dt, name="Mixed_7c")(net)
+        taps["Mixed_7c"] = net
+        return taps
+
+
+class InceptionV3Flow(nn.Module):
+    flow_channels: int = 2
+    dtype: Any = jnp.float32
+
+    flow_scales: tuple[float, ...] = FLOW_SCALES
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
+        taps = InceptionV3Base(dtype=self.dtype, name="encoder")(x)
+        flows = FlowDecoder(
+            upconv_features=(512, 256, 128, 64, 32),
+            scales=(2, 2, 1, 2, 2),  # Mixed_5d and MaxPool_5a share a size
+            flow_channels=self.flow_channels,
+            dtype=self.dtype,
+            name="decoder",
+        )([taps["Mixed_7c"], taps["Mixed_6e"], taps["Mixed_5d"],
+           taps["MaxPool_5a_3x3"], taps["MaxPool_3a_3x3"], taps["Conv2d_1a_3x3"]])
+        return flows[::-1]

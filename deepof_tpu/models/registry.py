@@ -1,0 +1,34 @@
+"""Model registry — replaces the reference's string-dispatch in
+`version1/trainOF.py:76-90` and the per-dataset trainer imports.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from .flownet_s import FlowNetS
+from .vgg16_flow import VGG16Flow
+from .inception_v3_flow import InceptionV3Flow
+from .flownet_c import FlowNetC
+from .two_stream import STBaseline, STSingle, UCF101Spatial
+
+MODELS = {
+    "flownet_s": FlowNetS,
+    "vgg16": VGG16Flow,
+    "inception_v3": InceptionV3Flow,
+    "flownet_c": FlowNetC,
+    "st_single": STSingle,
+    "st_baseline": STBaseline,
+    "ucf101_spatial": UCF101Spatial,
+}
+
+
+def build_model(name: str, flow_channels: int = 2, dtype: Any = jnp.float32, **kw):
+    if name not in MODELS:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(MODELS)}")
+    cls = MODELS[name]
+    if name == "ucf101_spatial":
+        return cls(dtype=dtype, **kw)
+    return cls(flow_channels=flow_channels, dtype=dtype, **kw)

@@ -164,3 +164,20 @@ def test_preprocess_and_lrn(rng):
     assert norm.shape == scaled.shape
     # LRN shrinks magnitudes (denominator >= 1)
     assert float(jnp.max(jnp.abs(norm))) <= float(jnp.max(jnp.abs(scaled))) + 1e-6
+
+
+def test_tiny_level_no_nan(rng):
+    """Coarse pyramid levels (h<=2) have an empty border-mask interior; the
+    loss must stay finite (regression: NaN via 0-division)."""
+    img = jnp.asarray(rng.rand(2, 2, 4, 3).astype(np.float32))
+    flow = jnp.asarray(rng.randn(2, 2, 4, 2).astype(np.float32))
+    ld, _ = loss_interp(flow, img, img, 0.3125, _loss_cfg())
+    for k in ("total", "Charbonnier_reconstruct", "U_loss", "V_loss"):
+        assert np.isfinite(float(ld[k])), k
+    # a degenerate level contributes exactly zero (not an unnormalized sum)
+    assert float(ld["U_loss"]) == 0.0 and float(ld["V_loss"]) == 0.0
+    assert float(ld["Charbonnier_reconstruct"]) == 0.0
+    vol = jnp.asarray(rng.rand(1, 1, 2, 9).astype(np.float32))
+    flows = jnp.asarray(rng.randn(1, 1, 2, 4).astype(np.float32))
+    ld2, _ = loss_interp_multi(flows, vol, 1.0, _loss_cfg())
+    assert np.isfinite(float(ld2["total"]))
