@@ -1,0 +1,160 @@
+"""On-device augmentation as pure JAX functions of a PRNG key.
+
+Capability union of the reference's two pipelines (SURVEY.md §2.6):
+  - numpy host pipeline (`flyingChairsUtils.py:83-294`): geometric =
+    translation (±0.2 of size), rotation (±17°), scale (0.9–2.0), L-R flip;
+    photometric = contrast (−0.8–0.4), additive brightness noise,
+    per-channel color (0.5–2), gamma (0.7–1.5), additive Gaussian noise
+    (σ ≤ 0.04) — both frames transformed identically per sample;
+  - TF in-graph pipeline (`version1/utils/augmentation.py`): same families,
+    narrower ranges, no rotation.
+
+Here both run jit-compiled on device under explicit PRNG keys (instead of
+host cv2 loops), with the numpy pipeline's ranges as defaults. Geometric
+transforms are expressed as an inverse-affine displacement field fed to the
+same `backward_warp` gather used by the loss — one code path for all
+resampling. Images are raw 0–255 BGR throughout; photometric ops work on
+x/255 and rescale (the trainer's `preprocess` does mean/255 afterwards).
+
+Dual-stream contract (`flyingChairsTrain_vgg.py:186-195`): `augment_batch`
+returns geo-only `source`/`target` (the loss pair) plus photo-augmented
+`net_source`/`net_target` (the network input pair).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import DataConfig
+from ..ops.warp import backward_warp
+
+# numpy-pipeline ranges (flyingChairsUtils.py:83-294)
+TRANSLATION = 0.2
+ROTATION_DEG = 17.0
+SCALE_RANGE = (0.9, 2.0)
+CONTRAST = (-0.8, 0.4)
+BRIGHTNESS_SIGMA = 0.2
+COLOR_RANGE = (0.5, 2.0)
+GAMMA_RANGE = (0.7, 1.5)
+NOISE_SIGMA_MAX = 0.04
+
+
+def sample_geo_params(key: jax.Array, batch: int,
+                      rotation: bool = True) -> dict[str, jnp.ndarray]:
+    """Per-sample geometric parameters: angle (rad), scale, translation
+    fractions, flip flag."""
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    rot = math.radians(ROTATION_DEG) if rotation else 0.0
+    return {
+        "angle": jax.random.uniform(k1, (batch,), minval=-rot, maxval=rot),
+        "scale": jax.random.uniform(k2, (batch,), minval=SCALE_RANGE[0],
+                                    maxval=SCALE_RANGE[1]),
+        "tx": jax.random.uniform(k3, (batch,), minval=-TRANSLATION,
+                                 maxval=TRANSLATION),
+        "ty": jax.random.uniform(k4, (batch,), minval=-TRANSLATION,
+                                 maxval=TRANSLATION),
+        "flip": jax.random.bernoulli(k5, 0.5, (batch,)),
+    }
+
+
+def identity_geo_params(batch: int) -> dict[str, jnp.ndarray]:
+    z = jnp.zeros((batch,))
+    return {"angle": z, "scale": z + 1.0, "tx": z, "ty": z,
+            "flip": jnp.zeros((batch,), bool)}
+
+
+def apply_geo(images: jnp.ndarray, params: dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Apply per-sample inverse-affine resampling to (B, H, W, C) images.
+
+    Output pixel p maps to input coordinate
+    c + R(-angle)/scale · flip_x · (p - c) - t·(W,H), clip-at-border bilinear
+    (same convention as the warp loss). Expressed as a displacement field so
+    `backward_warp` does the gather.
+    """
+    b, h, w, _ = images.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    dx = (xs - cx)[None]  # (1, H, W)
+    dy = (ys - cy)[None]
+
+    ang = params["angle"][:, None, None]
+    inv_s = 1.0 / params["scale"][:, None, None]
+    fx = jnp.where(params["flip"], -1.0, 1.0)[:, None, None]
+    cos, sin = jnp.cos(-ang), jnp.sin(-ang)
+
+    dxf = dx * fx  # flip about the vertical axis first (in output space)
+    src_x = cx + inv_s * (cos * dxf - sin * dy) - params["tx"][:, None, None] * w
+    src_y = cy + inv_s * (sin * dxf + cos * dy) - params["ty"][:, None, None] * h
+
+    flow = jnp.stack([src_x - xs[None], src_y - ys[None]], axis=-1)  # (B,H,W,2)
+    return backward_warp(images, flow)
+
+
+def photometric_augment(key: jax.Array, *frames: jnp.ndarray,
+                        color_range=COLOR_RANGE, contrast=CONTRAST,
+                        gamma_range=GAMMA_RANGE) -> list[jnp.ndarray]:
+    """Contrast/brightness/color/gamma/noise, identical parameters for every
+    frame of a sample (`flyingChairsUtils.py:220-294`). Frames are 0–255."""
+    b = frames[0].shape[0]
+    kc, kb, kcol, kg, kn1, kn2 = jax.random.split(key, 6)
+    c = jax.random.uniform(kc, (b, 1, 1, 1), minval=contrast[0], maxval=contrast[1])
+    bright = jax.random.normal(kb, (b, 1, 1, 1)) * BRIGHTNESS_SIGMA
+    color = jax.random.uniform(kcol, (b, 1, 1, 3), minval=color_range[0],
+                               maxval=color_range[1])
+    gamma = jax.random.uniform(kg, (b, 1, 1, 1), minval=gamma_range[0],
+                               maxval=gamma_range[1])
+    sigma = jax.random.uniform(kn1, (b, 1, 1, 1), maxval=NOISE_SIGMA_MAX)
+
+    out = []
+    for i, f in enumerate(frames):
+        x = f / 255.0
+        x = x * (1.0 + c)          # contrast about black
+        x = x + bright             # brightness offset
+        x = x * color              # per-channel color
+        x = jnp.clip(x, 0.0, 1.0) ** gamma
+        noise = jax.random.normal(jax.random.fold_in(kn2, i), f.shape) * sigma
+        x = jnp.clip(x + noise, 0.0, 1.0)
+        out.append(x * 255.0)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("geo", "photo", "rotation"))
+def augment_batch(batch: dict, key: jax.Array, geo: bool = True,
+                  photo: bool = True, rotation: bool = True) -> dict:
+    """Dual-stream augmentation of a {source, target, ...} batch.
+
+    Returns the batch with geo-transformed source/target (loss pair) and,
+    when `photo`, additional net_source/net_target (network pair). Extra
+    keys (flow, label) pass through untouched — GT flow is only used for
+    eval, which never augments (`flyingChairsTrain_vgg.py:266-271`).
+    """
+    src, tgt = batch["source"], batch["target"]
+    kg, kp = jax.random.split(key)
+    if geo:
+        params = sample_geo_params(kg, src.shape[0], rotation)
+        src, tgt = apply_geo(src, params), apply_geo(tgt, params)
+    out = dict(batch)
+    out["source"], out["target"] = src, tgt
+    if photo:
+        out["net_source"], out["net_target"] = photometric_augment(kp, src, tgt)
+    return out
+
+
+def make_augment_fn(cfg: DataConfig):
+    """Host-callable augmenter: (numpy batch, int seed) -> augmented batch."""
+    geo, photo = cfg.augment_geo, cfg.augment_photo
+
+    def fn(batch: dict, seed) -> dict:
+        key = jax.random.PRNGKey(int(seed))
+        out = augment_batch(batch, key, geo=geo, photo=photo)
+        return {k: np.asarray(v) if k in ("source", "target", "net_source",
+                                          "net_target") else v
+                for k, v in out.items()}
+
+    return fn

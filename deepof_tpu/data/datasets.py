@@ -1,0 +1,379 @@
+"""Dataset index builders and batch samplers.
+
+Numpy/cv2 host-side loaders with decoded-image caching. Batches are dicts of
+float32 numpy arrays, BGR channel order with per-dataset means preserved from
+the reference (`flyingChairsLoader.py:28`, `sintelLoader.py:29`,
+`version1/loader/ucf101Loader.py` mean [104,117,123]).
+
+Split semantics:
+  - FlyingChairs: official `FlyingChairs_train_val.txt` (one marker per
+    sample, 1=train 2=val, `flyingChairsLoader.py:47-55`). Zero-egress: no
+    auto-download; if the file is absent the last 640 samples become val
+    (documented divergence from the reference's wget at
+    `flyingChairsLoader.py:31-34`).
+  - Sintel: all T-frame sliding windows per clip
+    (`sintelLoader.py:31-45`); val = the first window of each clip, padded
+    with a second window of the first clip to reach 24
+    (`sintelLoader.py:47-70` picks bamboo_2's second window; we pad
+    deterministically from clip 0 — same count, documented).
+  - UCF-101: clip group number > 7 -> train (`ucf101Loader.py:42-58`);
+    train batch = one random frame-pair from each of B distinct random
+    classes (`ucf101Loader.py:66-87`).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Protocol
+
+import numpy as np
+
+try:  # pragma: no cover - exercised implicitly
+    import cv2
+except Exception:  # noqa: BLE001
+    cv2 = None
+
+from ..core.config import DataConfig
+from ..io.flo import read_flo
+
+FLYINGCHAIRS_MEAN = (97.533, 99.238, 97.056)  # BGR, flyingChairsLoader.py:28
+SINTEL_MEAN = (70.1433, 83.1915, 92.8827)  # sintelLoader.py:29
+UCF101_MEAN = (104.0, 117.0, 123.0)  # version1/loader/ucf101Loader.py
+
+
+def _imread_bgr(path: str) -> np.ndarray:
+    img = cv2.imread(path, cv2.IMREAD_COLOR)  # BGR, matches reference cv2 use
+    if img is None:
+        raise FileNotFoundError(path)
+    return img
+
+
+def _resize(img: np.ndarray, hw: tuple[int, int]) -> np.ndarray:
+    if img.shape[:2] == tuple(hw):
+        return img
+    return cv2.resize(img, (hw[1], hw[0]), interpolation=cv2.INTER_LINEAR)
+
+
+class Dataset(Protocol):
+    """Batch-sampler protocol shared by all datasets.
+
+    `sample_train` returns a dict with at least the network-input tensors;
+    `num_train`/`num_val` drive the epoch loop like the reference's
+    `trainNum`/`valNum` (`version1/loader/flyingChairsLoader.py:26-36`).
+    """
+
+    mean: tuple[float, float, float]
+    num_train: int
+    num_val: int
+
+    def sample_train(self, batch_size: int, iteration: int | None = None,
+                     rng: np.random.RandomState | None = None) -> dict: ...
+
+    def sample_val(self, batch_size: int, batch_id: int) -> dict: ...
+
+
+class _DecodedCache:
+    """Unbounded decoded-image cache for the small benchmark datasets
+    (SURVEY.md §7.3.4: per-step host decode starves a TPU)."""
+
+    def __init__(self, enabled: bool, reader):
+        self._enabled = enabled
+        self._reader = reader
+        self._store: dict[str, np.ndarray] = {}
+
+    def __call__(self, path: str) -> np.ndarray:
+        if not self._enabled:
+            return self._reader(path)
+        hit = self._store.get(path)
+        if hit is None:
+            hit = self._store[path] = self._reader(path)
+        return hit
+
+
+class FlyingChairsData:
+    """FlyingChairs pairs: `XXXXX_img1.ppm`, `XXXXX_img2.ppm`, `XXXXX_flow.flo`.
+
+    Images are resized to `cfg.image_size`; ground-truth flow stays at its
+    native resolution (`flyingChairsLoader.py:71-81`). Supports both the
+    gen-2 sequential batching (`iteration` arg, `flyingChairsLoader.py:57-62`)
+    and gen-1 random sampling (`version1/loader/flyingChairsLoader.py:66-70`).
+    """
+
+    mean = FLYINGCHAIRS_MEAN
+
+    def __init__(self, cfg: DataConfig):
+        self.cfg = cfg
+        root = cfg.data_path
+        ids = sorted(
+            m.group(1)
+            for f in os.listdir(root)
+            if (m := re.match(r"(\d+)_img1\.ppm$", f))
+        )
+        if not ids:
+            raise FileNotFoundError(f"no *_img1.ppm under {root}")
+        split_file = os.path.join(root, "FlyingChairs_train_val.txt")
+        if not os.path.exists(split_file):
+            split_file = os.path.join(os.path.dirname(root), "FlyingChairs_train_val.txt")
+        if os.path.exists(split_file):
+            markers = np.loadtxt(split_file, dtype=int)[: len(ids)]
+        else:  # zero-egress fallback: last 640 (capped at 10%, min 1) are val
+            n_val = min(640, max(1, len(ids) // 10))
+            markers = np.ones(len(ids), dtype=int)
+            markers[-n_val:] = 2
+        self.train_ids = [i for i, m in zip(ids, markers) if m == 1]
+        self.val_ids = [i for i, m in zip(ids, markers) if m == 2]
+        self.num_train, self.num_val = len(self.train_ids), len(self.val_ids)
+        self._root = root
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+
+    def _load(self, sid: str, with_flow: bool) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+        p = os.path.join(self._root, sid)
+        src = _resize(self._cache(p + "_img1.ppm"), self.cfg.image_size)
+        tgt = _resize(self._cache(p + "_img2.ppm"), self.cfg.image_size)
+        flow = read_flo(p + "_flow.flo") if with_flow else None
+        return src, tgt, flow
+
+    def _batch(self, sids: list[str]) -> dict:
+        srcs, tgts, flows = zip(*(self._load(s, True) for s in sids))
+        return {
+            "source": np.stack(srcs).astype(np.float32),
+            "target": np.stack(tgts).astype(np.float32),
+            "flow": np.stack(flows).astype(np.float32),
+        }
+
+    def sample_train(self, batch_size, iteration=None, rng=None):
+        if iteration is not None:  # sequential, gen-2
+            start = (iteration * batch_size) % max(self.num_train - batch_size + 1, 1)
+            sids = self.train_ids[start : start + batch_size]
+        else:
+            rng = rng or np.random
+            sids = [self.train_ids[i] for i in rng.randint(0, self.num_train, batch_size)]
+        return self._batch(sids)
+
+    def sample_val(self, batch_size, batch_id):
+        start = (batch_id * batch_size) % max(self.num_val, 1)
+        sids = [self.val_ids[(start + k) % self.num_val] for k in range(batch_size)]
+        return self._batch(sids)
+
+
+class SintelData:
+    """MPI-Sintel T-frame sliding-window volumes.
+
+    Layout: `training/<pass>/<clip>/frame_XXXX.png`,
+    `training/flow/<clip>/frame_XXXX.flo` (`sintelLoader.py:20-45`). Batches:
+    volume (B, H, W, 3T) channel-stacked frames + flows (B, H, W, 2(T-1))
+    at native GT resolution (`sintelLoader.py:77-93`). Optional random crop
+    to `cfg.crop_size` of the network input (train only, `deepOF.py:14-16`).
+    """
+
+    mean = SINTEL_MEAN
+
+    def __init__(self, cfg: DataConfig):
+        self.cfg = cfg
+        self.t = cfg.time_step
+        img_root = os.path.join(cfg.data_path, "training", cfg.sintel_pass)
+        flow_root = os.path.join(cfg.data_path, "training", "flow")
+        clips = sorted(os.listdir(img_root))
+        self.windows: list[list[str]] = []  # absolute frame paths per window
+        self.flow_windows: list[list[str]] = []
+        first_windows: list[int] = []
+        second_windows: list[int] = []
+        for clip in clips:
+            frames = sorted(
+                os.path.join(img_root, clip, f)
+                for f in os.listdir(os.path.join(img_root, clip))
+                if f.endswith(".png")
+            )
+            flows = sorted(
+                os.path.join(flow_root, clip, f)
+                for f in os.listdir(os.path.join(flow_root, clip))
+                if f.endswith(".flo")
+            )
+            for s in range(0, len(frames) - self.t + 1):
+                if s == 0:
+                    first_windows.append(len(self.windows))
+                elif s == 1:
+                    second_windows.append(len(self.windows))
+                self.windows.append(frames[s : s + self.t])
+                self.flow_windows.append(flows[s : s + self.t - 1])
+        # val = first window of each clip (+ pad to 24 with second windows)
+        val = list(first_windows)
+        for idx in second_windows:
+            if len(val) >= 24:
+                break
+            val.append(idx)
+        self.val_idx = val[:24]
+        self.train_idx = [i for i in range(len(self.windows)) if i not in set(self.val_idx)]
+        self.num_train, self.num_val = len(self.train_idx), len(self.val_idx)
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+
+    def _window(self, w: int, crop_rng: np.random.RandomState | None) -> tuple[np.ndarray, np.ndarray]:
+        imgs = [_resize(self._cache(p), self.cfg.image_size) for p in self.windows[w]]
+        vol = np.concatenate(imgs, axis=-1).astype(np.float32)  # (H,W,3T)
+        if crop_rng is not None and self.cfg.crop_size is not None:
+            ch, cw = self.cfg.crop_size
+            h, w_ = vol.shape[:2]
+            y = crop_rng.randint(0, h - ch + 1)
+            x = crop_rng.randint(0, w_ - cw + 1)
+            vol = vol[y : y + ch, x : x + cw]
+        flows = np.concatenate(
+            [read_flo(p) for p in self.flow_windows[w]], axis=-1
+        ).astype(np.float32)  # native res, (H,W,2(T-1))
+        return vol, flows
+
+    def _batch(self, idxs, crop_rng=None):
+        vols, flows = zip(*(self._window(i, crop_rng) for i in idxs))
+        return {"volume": np.stack(vols), "flow": np.stack(flows)}
+
+    def sample_train(self, batch_size, iteration=None, rng=None):
+        rng = rng or np.random.RandomState()
+        idxs = [self.train_idx[i] for i in rng.randint(0, self.num_train, batch_size)]
+        return self._batch(idxs, crop_rng=rng)
+
+    def sample_val(self, batch_size, batch_id):
+        start = (batch_id * batch_size) % max(self.num_val, 1)
+        idxs = [self.val_idx[(start + k) % self.num_val] for k in range(batch_size)]
+        return self._batch(idxs)
+
+
+class UCF101Data:
+    """UCF-101 frame pairs for joint flow + action learning.
+
+    Layout: `frames/<class>/<clip>/<frame>.jpg`, clip names
+    `v_<Class>_gNN_cMM`; group NN > 7 -> train (`ucf101Loader.py:42-58`).
+    Train batch: one random consecutive pair from each of B distinct random
+    classes, with the class index as the action label
+    (`ucf101Loader.py:66-87`).
+    """
+
+    mean = UCF101_MEAN
+
+    def __init__(self, cfg: DataConfig):
+        self.cfg = cfg
+        root = os.path.join(cfg.data_path, "frames")
+        self.classes = sorted(os.listdir(root))
+        self.train_clips: dict[int, list[list[str]]] = {}
+        self.val_clips: dict[int, list[list[str]]] = {}
+        for ci, cls in enumerate(self.classes):
+            for clip in sorted(os.listdir(os.path.join(root, cls))):
+                frames = sorted(
+                    os.path.join(root, cls, clip, f)
+                    for f in os.listdir(os.path.join(root, cls, clip))
+                )
+                if len(frames) < 2:
+                    continue
+                m = re.search(r"_g(\d+)_", clip)
+                group = int(m.group(1)) if m else 99
+                (self.train_clips if group > 7 else self.val_clips).setdefault(
+                    ci, []
+                ).append(frames)
+        self.num_train = sum(len(v) for v in self.train_clips.values())
+        self.num_val = sum(len(v) for v in self.val_clips.values())
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+
+    def _pair(self, frames: list[str], rng) -> tuple[np.ndarray, np.ndarray]:
+        i = rng.randint(0, len(frames) - 1)
+        src = _resize(self._cache(frames[i]), self.cfg.image_size)
+        tgt = _resize(self._cache(frames[i + 1]), self.cfg.image_size)
+        return src, tgt
+
+    def _batch_from(self, clips: dict[int, list[list[str]]], class_ids, rng):
+        srcs, tgts, labels = [], [], []
+        for ci in class_ids:
+            pool = clips[ci]
+            src, tgt = self._pair(pool[rng.randint(0, len(pool))], rng)
+            srcs.append(src)
+            tgts.append(tgt)
+            labels.append(ci)
+        return {
+            "source": np.stack(srcs).astype(np.float32),
+            "target": np.stack(tgts).astype(np.float32),
+            "label": np.asarray(labels, np.int32),
+        }
+
+    def sample_train(self, batch_size, iteration=None, rng=None):
+        rng = rng or np.random.RandomState()
+        avail = list(self.train_clips)
+        replace = batch_size > len(avail)
+        class_ids = rng.choice(avail, size=batch_size, replace=replace)
+        return self._batch_from(self.train_clips, class_ids, rng)
+
+    def sample_val(self, batch_size, batch_id):
+        """One batch from a single class — the reference evaluates 101
+        class-batches in turn (`ucf101train.py:210-223`)."""
+        rng = np.random.RandomState(batch_id)
+        avail = sorted(self.val_clips)
+        ci = avail[batch_id % len(avail)]
+        return self._batch_from(self.val_clips, [ci] * batch_size, rng)
+
+
+class SyntheticData:
+    """Procedural dataset with exact ground-truth flow, for tests and the
+    benchmark harness (no counterpart in the reference, which has no tests).
+
+    Each sample: a smooth random image; the target is the source translated
+    by a per-sample constant (u, v) — so GT flow is uniform and the
+    unsupervised loss is minimized by the true flow.
+    """
+
+    mean = (0.0, 0.0, 0.0)
+
+    def __init__(self, cfg: DataConfig, num_train: int = 64, num_val: int = 16,
+                 max_shift: float = 4.0):
+        self.cfg = cfg
+        self.num_train, self.num_val = num_train, num_val
+        self._max_shift = max_shift
+
+    def _sample(self, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        rng = np.random.RandomState(seed)
+        h, w = self.cfg.image_size
+        base = rng.rand(h // 8 + 2, w // 8 + 2, 3).astype(np.float32) * 255.0
+        img = cv2.resize(base, (w + 16, h + 16), interpolation=cv2.INTER_CUBIC)
+        u, v = rng.randint(-self._max_shift, self._max_shift + 1, 2)
+        src = img[8 : 8 + h, 8 : 8 + w]
+        tgt = img[8 + v : 8 + v + h, 8 + u : 8 + u + w]
+        flow = np.broadcast_to(
+            np.asarray([u, v], np.float32), (h, w, 2)
+        ).copy()
+        return src, tgt, flow
+
+    def _batch(self, seeds) -> dict:
+        srcs, tgts, flows = zip(*(self._sample(int(s)) for s in seeds))
+        t = self.cfg.time_step
+        out = {
+            "source": np.stack(srcs),
+            "target": np.stack(tgts),
+            "flow": np.stack(flows),
+            "label": np.asarray([int(s) % 101 for s in seeds], np.int32),
+        }
+        if t > 2:  # volume mode: repeat the pair into a T-frame volume
+            vol = [out["source"], out["target"]] * ((t + 1) // 2)
+            out["volume"] = np.concatenate(vol[:t], axis=-1)
+            out["flow"] = np.concatenate([out["flow"]] * (t - 1), axis=-1)
+        return out
+
+    def sample_train(self, batch_size, iteration=None, rng=None):
+        if iteration is not None:
+            seeds = [(iteration * batch_size + k) % self.num_train for k in range(batch_size)]
+        else:
+            rng = rng or np.random
+            seeds = rng.randint(0, self.num_train, batch_size)
+        return self._batch(seeds)
+
+    def sample_val(self, batch_size, batch_id):
+        seeds = [self.num_train + (batch_id * batch_size + k) % self.num_val
+                 for k in range(batch_size)]
+        return self._batch(seeds)
+
+
+def build_dataset(cfg: DataConfig) -> Dataset:
+    builders = {
+        "flyingchairs": FlyingChairsData,
+        "sintel": SintelData,
+        "ucf101": UCF101Data,
+        "synthetic": SyntheticData,
+    }
+    if cfg.dataset not in builders:
+        raise KeyError(f"unknown dataset {cfg.dataset!r}; available: {sorted(builders)}")
+    return builders[cfg.dataset](cfg)

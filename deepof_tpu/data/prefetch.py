@@ -1,0 +1,71 @@
+"""Async double-buffered host->device prefetcher.
+
+Replaces the reference's synchronous per-step disk->numpy->feed_dict path
+(`sintelTrain.py:189-195`, SURVEY.md §3.1 hot loop): a background thread
+decodes/assembles the next batches while the device runs the current step,
+and batches are placed on device (optionally with a NamedSharding) ahead of
+use so the train step never waits on host IO.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+import jax
+
+
+class Prefetcher:
+    """Wraps a batch-producing callable into a prefetching iterator.
+
+    next_batch: () -> dict[str, np.ndarray] (host numpy)
+    sharding: optional jax.sharding.Sharding applied via device_put.
+    """
+
+    def __init__(
+        self,
+        next_batch: Callable[[], dict],
+        depth: int = 2,
+        sharding: jax.sharding.Sharding | None = None,
+    ):
+        self._next = next_batch
+        self._sharding = sharding
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._exc: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                batch = self._next()
+                if self._sharding is not None:
+                    batch = jax.device_put(batch, self._sharding)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 - surfaced on get()
+            self._exc = e
+
+    def get(self) -> dict:
+        while True:
+            if self._exc is not None:
+                raise self._exc
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._exc is None:
+                    raise RuntimeError("prefetch thread died without error")
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.get()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)

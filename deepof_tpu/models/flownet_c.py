@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.corr import correlation
-from .common import ConvELU, FlowDecoder
+from .common import ConvELU, FlowDecoder, flownet_tail
 from .flownet_s import FLOW_SCALES
 
 
@@ -46,12 +46,7 @@ class FlowNetC(nn.Module):
         net = jnp.concatenate([corr, redir], axis=-1)
 
         conv3_1 = ConvELU(256, dtype=dt, name="conv3_1")(net)
-        conv4_1 = ConvELU(512, stride=2, dtype=dt, name="conv4_1")(conv3_1)
-        conv4_2 = ConvELU(512, dtype=dt, name="conv4_2")(conv4_1)
-        conv5_1 = ConvELU(512, stride=2, dtype=dt, name="conv5_1")(conv4_2)
-        conv5_2 = ConvELU(512, dtype=dt, name="conv5_2")(conv5_1)
-        conv6_1 = ConvELU(1024, stride=2, dtype=dt, name="conv6_1")(conv5_2)
-        conv6_2 = ConvELU(1024, dtype=dt, name="conv6_2")(conv6_1)
+        conv4_2, conv5_2, conv6_2 = flownet_tail(conv3_1, dt)
 
         flows = FlowDecoder(
             upconv_features=(512, 256, 128, 64, 32),

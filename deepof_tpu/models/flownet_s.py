@@ -17,7 +17,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .common import ConvELU, FlowDecoder
+from .common import FlowDecoder, flownet_trunk
 
 FLOW_SCALES = (10.0, 5.0, 2.5, 1.25, 0.625, 0.3125)  # finest (pr1) first
 
@@ -30,22 +30,11 @@ class FlowNetS(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
-        dt = self.dtype
-        conv1 = ConvELU(64, (7, 7), 2, dtype=dt, name="conv1")(x)
-        conv2 = ConvELU(128, (5, 5), 2, dtype=dt, name="conv2")(conv1)
-        conv3_1 = ConvELU(256, (5, 5), 2, dtype=dt, name="conv3_1")(conv2)
-        conv3_2 = ConvELU(256, dtype=dt, name="conv3_2")(conv3_1)
-        conv4_1 = ConvELU(512, stride=2, dtype=dt, name="conv4_1")(conv3_2)
-        conv4_2 = ConvELU(512, dtype=dt, name="conv4_2")(conv4_1)
-        conv5_1 = ConvELU(512, stride=2, dtype=dt, name="conv5_1")(conv4_2)
-        conv5_2 = ConvELU(512, dtype=dt, name="conv5_2")(conv5_1)
-        conv6_1 = ConvELU(1024, stride=2, dtype=dt, name="conv6_1")(conv5_2)
-        conv6_2 = ConvELU(1024, dtype=dt, name="conv6_2")(conv6_1)
-
+        taps = flownet_trunk(x, self.dtype)
         flows = FlowDecoder(
             upconv_features=(512, 256, 128, 64, 32),
             flow_channels=self.flow_channels,
-            dtype=dt,
+            dtype=self.dtype,
             name="decoder",
-        )([conv6_2, conv5_2, conv4_2, conv3_2, conv2, conv1])
+        )(taps[::-1])
         return flows[::-1]  # finest first

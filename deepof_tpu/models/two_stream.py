@@ -25,7 +25,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .common import ConvELU, FlowDecoder, conv_init
+from .common import FlowDecoder, conv_init, flownet_trunk
 from .flownet_s import FLOW_SCALES as FLOWNET_SCALES
 from .vgg16_flow import FLOW_SCALES as VGG_SCALES
 from .vgg16_flow import VGG16Trunk
@@ -79,6 +79,8 @@ class UCF101Spatial(nn.Module):
     num_classes: int = 101
     dtype: Any = jnp.float32
 
+    classifier_only = True  # step dispatch: logits, no flow pyramid
+
     @nn.compact
     def __call__(self, frame: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         pools = _VGGReLUTrunk(dtype=self.dtype, name="encoder")(frame)
@@ -93,6 +95,7 @@ class STSingle(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = VGG_SCALES
+    has_action_head = True  # step dispatch: returns (flows, logits)
 
     @nn.compact
     def __call__(self, pair: jnp.ndarray, train: bool = False):
@@ -120,28 +123,21 @@ class STBaseline(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOWNET_SCALES
+    has_action_head = True  # step dispatch: returns (flows, logits)
 
     @nn.compact
     def __call__(self, pair: jnp.ndarray, train: bool = False):
         dt = self.dtype
         # temporal FlowNet-S trunk
-        t1 = ConvELU(64, (7, 7), 2, dtype=dt, name="Tconv1")(pair)
-        t2 = ConvELU(128, (5, 5), 2, dtype=dt, name="Tconv2")(t1)
-        t3_1 = ConvELU(256, (5, 5), 2, dtype=dt, name="Tconv3_1")(t2)
-        t3_2 = ConvELU(256, dtype=dt, name="Tconv3_2")(t3_1)
-        t4_1 = ConvELU(512, stride=2, dtype=dt, name="Tconv4_1")(t3_2)
-        t4_2 = ConvELU(512, dtype=dt, name="Tconv4_2")(t4_1)
-        t5_1 = ConvELU(512, stride=2, dtype=dt, name="Tconv5_1")(t4_2)
-        t5_2 = ConvELU(512, dtype=dt, name="Tconv5_2")(t5_1)
-        t6_1 = ConvELU(1024, stride=2, dtype=dt, name="Tconv6_1")(t5_2)
-        t6_2 = ConvELU(1024, dtype=dt, name="Tconv6_2")(t6_1)
+        taps = flownet_trunk(pair, dt, prefix="Tconv")
+        t5_2, t6_2 = taps[4], taps[5]
 
         flows = FlowDecoder(
             upconv_features=(512, 256, 128, 64, 32),
             flow_channels=self.flow_channels,
             dtype=dt,
             name="decoder",
-        )([t6_2, t5_2, t4_2, t3_2, t2, t1])
+        )(taps[::-1])
 
         # spatial VGG16 on frame 1
         pools = _VGGReLUTrunk(dtype=dt, name="spatial")(pair[..., :3])

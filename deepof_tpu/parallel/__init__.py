@@ -1,0 +1,11 @@
+"""Device-mesh construction and parallelism primitives.
+
+The reference is single-process, single-GPU (`flyingChairsTrain.py:99`,
+SURVEY.md §2.7) — everything here is new, TPU-native capability: named
+meshes over ICI, sharding helpers for pjit data parallelism, and spatial
+context-parallel convolution/warp with halo exchange.
+"""
+
+from .mesh import batch_sharding, build_mesh, local_mesh, replicated_sharding
+
+__all__ = ["build_mesh", "local_mesh", "batch_sharding", "replicated_sharding"]

@@ -1,0 +1,58 @@
+"""Named device meshes for pjit sharding.
+
+Axes (SURVEY.md §5.8 build plan):
+  - "data":    batch/data parallelism — gradients all-reduce over ICI;
+  - "spatial": context parallelism over image height (halo exchange);
+  - "time":    Sintel temporal pair parallelism (T-1 independent pair
+               losses).
+
+Multi-host: call `jax.distributed.initialize` before `build_mesh`; the mesh
+uses the global device list, so the "data" axis spans hosts over DCN while
+"spatial"/"time" should stay intra-slice (ICI).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.config import MeshConfig
+
+AXES = ("data", "spatial", "time")
+
+
+def build_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a (data, spatial, time) mesh over `devices` (default: all).
+
+    cfg.data == -1 means "all remaining devices" after spatial/time are
+    allocated.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    spatial, time = max(cfg.spatial, 1), max(cfg.time, 1)
+    if n % (spatial * time):
+        raise ValueError(
+            f"{n} devices not divisible by spatial*time={spatial * time}")
+    data = n // (spatial * time) if cfg.data == -1 else cfg.data
+    if data * spatial * time != n:
+        raise ValueError(
+            f"mesh {data}x{spatial}x{time} != {n} devices")
+    arr = np.asarray(devices).reshape(data, spatial, time)
+    return Mesh(arr, AXES)
+
+
+def local_mesh(n: int | None = None) -> Mesh:
+    """Pure-data-parallel mesh over the first n devices (test helper)."""
+    devices = jax.devices()[: n or len(jax.devices())]
+    return build_mesh(MeshConfig(), devices)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over "data"; replicate the rest."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,26 @@
+"""Training drivers (L4): pjit step functions, optimization, checkpointing,
+evaluation, and the epoch loop — replacing the reference's per-dataset
+`*Train.py` session loops (SURVEY.md §2.2) with one dataset-agnostic engine.
+"""
+
+from .checkpoint import CheckpointManager
+from .evaluate import evaluate_aee, evaluate_ucf101
+from .loop import Trainer
+from .metrics_log import MetricsLogger, StepTimer
+from .schedule import step_decay_schedule
+from .state import TrainState, create_train_state
+from .step import make_eval_fn, make_train_step
+
+__all__ = [
+    "CheckpointManager",
+    "MetricsLogger",
+    "StepTimer",
+    "TrainState",
+    "Trainer",
+    "create_train_state",
+    "evaluate_aee",
+    "evaluate_ucf101",
+    "make_eval_fn",
+    "make_train_step",
+    "step_decay_schedule",
+]

@@ -1,0 +1,64 @@
+"""Full train-state checkpointing with auto-resume.
+
+Replaces `tf.train.Saver` model-variables-only checkpoints
+(`flyingChairsTrain.py:156-161,211-213`) with orbax checkpoints of the whole
+TrainState pytree — params + optimizer state + step + PRNG key — so resume
+continues the LR schedule and optimizer moments exactly (fixes the
+reference deficiency in SURVEY.md §5.4). Restore-if-present at startup
+mirrors the reference's `get_checkpoint_state` behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+import jax
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = re.match(r"step_(\d+)$", name)
+            # only completed orbax dirs (atomic rename drops the tmp suffix)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, state: TrainState) -> str:
+        step = int(jax.device_get(state.step))
+        path = self._path(step)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        self._ckpt.save(path, state)
+        for old in self.all_steps()[: -self.keep]:
+            shutil.rmtree(self._path(old), ignore_errors=True)
+        return path
+
+    def restore(self, template: TrainState, step: int | None = None) -> TrainState | None:
+        """Restore into the structure of `template` (shapes/dtypes/shardings
+        come from the abstract template, the non-pytree `tx` is carried
+        over). Returns None if no checkpoint exists."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        restored = self._ckpt.restore(self._path(step), item=template)
+        return restored.replace(tx=template.tx)

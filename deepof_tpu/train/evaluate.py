@@ -1,0 +1,113 @@
+"""Evaluation protocols.
+
+Reproduces the reference's AEE measurement conventions exactly
+(SURVEY.md §6): the finest prediction (already multiplied by its
+flow_scale) is multiplied by the dataset `eval_amplifier`, clipped to
+`eval_clip`, bilinearly resized to the native ground-truth resolution, and
+compared against GT flow with mean endpoint error:
+
+  - FlyingChairs: x2, clip [-300, 250], resize to 384x512
+    (`flyingChairsTrain.py:264-296`);
+  - Sintel: x3, clip [-420.621, 426.311], resize to 436x1024, averaged over
+    all T-1 flow pairs (`sintelTrain.py:264-328`);
+  - UCF-101: action accuracy over per-class batches (`ucf101train.py:210-287`).
+
+Visual artifacts (flow color images, warped frames) mirror the reference's
+cv2.imwrite dumps (`flyingChairsTrain.py:272-291`).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+try:
+    import cv2
+except Exception:  # noqa: BLE001
+    cv2 = None
+
+from ..core.config import ExperimentConfig
+from ..utils.flowviz import flow_to_color
+from ..utils.metrics import flow_aae, flow_epe
+
+
+def postprocess_flow(flow: np.ndarray, cfg: ExperimentConfig,
+                     gt_hw: tuple[int, int]) -> np.ndarray:
+    """(B, h, w, 2k) net output -> amplified/clipped/native-res flow."""
+    lo, hi = cfg.train.eval_clip
+    flow = np.clip(flow * cfg.train.eval_amplifier, lo, hi)
+    b, h, w, c = flow.shape
+    gh, gw = gt_hw
+    if (h, w) == (gh, gw):
+        return flow
+    out = np.empty((b, gh, gw, c), np.float32)
+    for i in range(b):
+        for p in range(0, c, 2):  # cv2.resize handles <=4 channels; per pair
+            out[i, :, :, p : p + 2] = cv2.resize(
+                flow[i, :, :, p : p + 2], (gw, gh), interpolation=cv2.INTER_LINEAR)
+    return out
+
+
+def dump_visuals(out_dir: str, tag: str, flow: np.ndarray,
+                 recon: np.ndarray | None = None,
+                 gt: np.ndarray | None = None) -> None:
+    """Write flow-color / reconstruction / GT images for sample 0."""
+    os.makedirs(out_dir, exist_ok=True)
+    cv2.imwrite(os.path.join(out_dir, f"{tag}_flow.png"),
+                flow_to_color(flow[0, :, :, :2]))
+    if gt is not None:
+        cv2.imwrite(os.path.join(out_dir, f"{tag}_gt.png"),
+                    flow_to_color(gt[0, :, :, :2]))
+    if recon is not None:
+        img = np.clip(recon[0, :, :, :3] * 255.0, 0, 255).astype(np.uint8)
+        cv2.imwrite(os.path.join(out_dir, f"{tag}_recon.png"), img)
+
+
+def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
+                 dump_dir: str | None = None) -> dict[str, float]:
+    """Run the AEE protocol over the full validation split."""
+    bs = cfg.train.eval_batch_size
+    n_batches = max(dataset.num_val // bs, 1)
+    epes, aaes, totals = [], [], []
+    for bid in range(n_batches):
+        batch = dataset.sample_val(bs, bid)
+        out = {k: np.asarray(v) for k, v in eval_fn(params, batch).items()}
+        gt = batch["flow"]
+        pred = postprocess_flow(out["flow"], cfg, gt.shape[1:3])
+        # AEE per flow pair, averaged (multi-frame: all T-1 pairs, like
+        # `sintelTrain.py:309-328`)
+        for p in range(0, gt.shape[-1], 2):
+            epes.append(float(flow_epe(pred[..., p : p + 2], gt[..., p : p + 2])))
+            aaes.append(float(flow_aae(pred[..., p : p + 2], gt[..., p : p + 2])))
+        totals.append(float(out["total"]))
+        if dump_dir and bid == 0:
+            dump_visuals(dump_dir, f"val{bid}", pred,
+                         out.get("recon"), gt)
+    return {
+        "aee": float(np.mean(epes)),
+        "aae": float(np.mean(aaes)),
+        "val_loss": float(np.mean(totals)),
+    }
+
+
+def evaluate_ucf101(eval_fn, params, dataset, cfg: ExperimentConfig,
+                    n_classes: int = 101) -> dict[str, float]:
+    """Action accuracy over one batch per class (`ucf101train.py:210-223`)."""
+    bs = cfg.train.eval_batch_size
+    correct, seen, totals = 0, 0, []
+    if hasattr(dataset, "val_clips"):
+        n = min(n_classes, max(len(dataset.val_clips), 1))
+    else:  # non-class datasets (synthetic): cover the val split once
+        n = max(dataset.num_val // bs, 1)
+    for bid in range(n):
+        batch = dataset.sample_val(bs, bid)
+        out = eval_fn(params, batch)
+        logits = np.asarray(out["logits"])
+        correct += int(np.sum(np.argmax(logits, -1) == batch["label"]))
+        seen += logits.shape[0]
+        totals.append(float(out["total"]))
+    return {
+        "accuracy": correct / max(seen, 1),
+        "val_loss": float(np.mean(totals)),
+    }

@@ -1,0 +1,167 @@
+"""The epoch-loop trainer — one engine for every dataset/model.
+
+Wires together mesh, dataset, prefetcher, pjit step, LR schedule,
+checkpointing, NaN guard, and evaluation; dataset-agnostic where the
+reference duplicates a session loop per dataset (`flyingChairsTrain.py`,
+`sintelTrain.py`, `ucf101train.py` — SURVEY.md §2.2).
+
+NaN handling upgrades the reference's crash-on-NaN assert
+(`flyingChairsTrain.py:203`) to restore-from-last-checkpoint
+(SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ExperimentConfig
+from ..data import Prefetcher, build_dataset
+from ..models.registry import build_model
+from ..parallel.mesh import batch_sharding, build_mesh
+from .checkpoint import CheckpointManager
+from .evaluate import evaluate_aee, evaluate_ucf101
+from .metrics_log import MetricsLogger, ProfilerSession, StepTimer
+from .schedule import step_decay_schedule
+from .state import create_train_state, make_optimizer
+from .step import make_eval_fn, make_train_step
+
+
+def _example_input(cfg: ExperimentConfig) -> jnp.ndarray:
+    h, w = cfg.data.crop_size or cfg.data.image_size
+    t = cfg.data.time_step
+    channels = 3 if cfg.model == "ucf101_spatial" else 3 * t
+    return jnp.zeros((cfg.data.batch_size, h, w, channels), jnp.float32)
+
+
+class Trainer:
+    def __init__(self, cfg: ExperimentConfig, dataset=None, mesh=None,
+                 profile: bool = False):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        self.dataset = dataset if dataset is not None else build_dataset(cfg.data)
+        t = cfg.data.time_step
+        flow_channels = 2 * (t - 1)
+        dtype = (jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16"
+                 else jnp.float32)
+        self.model = build_model(cfg.model, flow_channels=flow_channels, dtype=dtype)
+
+        self.logger = MetricsLogger(cfg.train.log_dir)
+        self.profiler = ProfilerSession(cfg.train.log_dir, enabled=profile)
+        self.steps_per_epoch = max(self.dataset.num_train // cfg.data.batch_size, 1)
+        schedule = step_decay_schedule(cfg.optim, self.steps_per_epoch)
+        self.schedule = schedule
+        tx = make_optimizer(cfg.optim, schedule)
+        self.state = create_train_state(
+            self.model, _example_input(cfg), tx, seed=cfg.train.seed,
+            log=lambda m: self.logger.log("info", 0, message=m))
+
+        self.ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt",
+                                      keep=cfg.train.keep_ckpts)
+        restored = self.ckpt.restore(self.state)
+        if restored is not None:
+            self.state = restored
+            self.logger.log("info", int(self.state.step),
+                            message=f"resumed from step {int(self.state.step)}")
+
+        smooth_border = cfg.model in ("st_single", "st_baseline")
+        self.train_step = make_train_step(self.model, cfg, self.dataset.mean,
+                                          self.mesh, smooth_border)
+        self.eval_fn = make_eval_fn(self.model, cfg, self.dataset.mean,
+                                    smooth_border_mask=smooth_border)
+        self._augment = None  # set by enable_augmentation()
+
+    def enable_augmentation(self) -> None:
+        if self.cfg.data.augment_geo or self.cfg.data.augment_photo:
+            from ..data.augmentation import make_augment_fn
+
+            self._augment = make_augment_fn(self.cfg.data)
+
+    def _next_train_batch(self, it: int, rng: np.random.RandomState) -> dict:
+        batch = self.dataset.sample_train(self.cfg.data.batch_size, rng=rng)
+        if self._augment is not None:
+            batch = self._augment(batch, np.int64(rng.randint(0, 2**31)))
+        return batch
+
+    def evaluate(self, dump: bool = False) -> dict[str, float]:
+        dump_dir = (self.cfg.train.log_dir + "/visuals") if dump else None
+        if self.cfg.model in ("st_single", "st_baseline", "ucf101_spatial"):
+            return evaluate_ucf101(self.eval_fn, self.state.params,
+                                   self.dataset, self.cfg)
+        return evaluate_aee(self.eval_fn, self.state.params, self.dataset,
+                            self.cfg, dump_dir)
+
+    def fit(self, num_epochs: int | None = None,
+            max_steps: int | None = None) -> dict[str, float]:
+        cfg = self.cfg
+        self.enable_augmentation()
+        rng = np.random.RandomState(cfg.train.seed)
+        sharding = batch_sharding(self.mesh)
+        it_holder = {"i": 0}
+
+        def produce():
+            b = self._next_train_batch(it_holder["i"], rng)
+            it_holder["i"] += 1
+            return b
+
+        prefetch = Prefetcher(produce, depth=cfg.data.prefetch, sharding=sharding)
+        timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
+        last_eval: dict[str, float] = {}
+        try:
+            start_step = int(self.state.step)
+            total_steps = (num_epochs or cfg.train.num_epochs) * self.steps_per_epoch
+            if max_steps is not None:
+                total_steps = min(total_steps, start_step + max_steps)
+            if cfg.train.nan_guard and self.ckpt.latest_step() is None:
+                self.ckpt.save(self.state)  # rollback target before step 1
+            self.profiler.maybe_start()
+            for step in range(start_step, total_steps):
+                batch = prefetch.get()
+                self.state, metrics = self.train_step(self.state, batch)
+                timer.tick()
+                epoch = (step + 1) // self.steps_per_epoch
+                end_of_epoch = (step + 1) % self.steps_per_epoch == 0
+                log_due = (step + 1) % cfg.train.log_every == 0 or end_of_epoch
+                eval_due = end_of_epoch or (
+                    cfg.train.eval_every and (step + 1) % cfg.train.eval_every == 0)
+
+                # NaN guard runs on every host-visible step (log or eval), so
+                # divergence never reaches an eval record; at most
+                # log_every-1 steps of NaN training are lost to the rollback.
+                if (log_due or eval_due) and cfg.train.nan_guard:
+                    total = float(jax.device_get(metrics["total"]))
+                    if not np.isfinite(total):
+                        self._rollback(step)
+                        continue
+
+                if log_due:
+                    total = float(jax.device_get(metrics["total"]))
+                    self.logger.log(
+                        "train", step + 1, epoch=epoch, loss=total,
+                        lr=float(self.schedule(step)),
+                        grad_norm=float(jax.device_get(metrics["grad_norm"])),
+                        **{k: jax.device_get(v) for k, v in metrics.items()
+                           if k in ("action_loss", "accuracy")},
+                        **timer.rates())
+                if eval_due:
+                    last_eval = self.evaluate(dump=cfg.train.dump_visuals)
+                    self.logger.log("eval", step + 1, epoch=epoch, **last_eval)
+                if end_of_epoch and epoch % cfg.train.ckpt_every_epochs == 0:
+                    self.ckpt.save(self.state)
+            self.profiler.maybe_stop()
+            self.ckpt.save(self.state)
+        finally:
+            prefetch.close()
+        rates = timer.rates()
+        return {**last_eval, **rates}
+
+    def _rollback(self, step: int) -> None:
+        restored = self.ckpt.restore(self.state)
+        if restored is None:
+            raise FloatingPointError(f"loss diverged to NaN at step {step} "
+                                     "with no checkpoint to roll back to")
+        self.state = restored
+        self.logger.log("warn", step,
+                        message=f"NaN at step {step}; rolled back to "
+                                f"step {int(restored.step)}")

@@ -1,0 +1,92 @@
+"""Structured metrics logging + step timing.
+
+Replaces the reference's print-only observability (SURVEY.md §5.5): every
+record is one JSON line (machine-parseable, the `analyze_test_loss.py`
+replacement reads it back), mirrored to stdout. StepTimer reports
+steps/sec and image-pairs/sec/chip — the BASELINE.json north-star metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def _scalarize(v):
+    if isinstance(v, (str, bool, int)):
+        return v
+    a = np.asarray(v)
+    return a.tolist() if a.ndim else float(a)
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str, filename: str = "metrics.jsonl",
+                 echo: bool = True):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(log_dir, filename)
+        self._f = open(self.path, "a", buffering=1)
+        self.echo = echo
+
+    def log(self, kind: str, step: int, **metrics) -> None:
+        rec = {"kind": kind, "step": int(step), "time": time.time()}
+        rec.update({k: _scalarize(v) for k, v in metrics.items()})
+        self._f.write(json.dumps(rec) + "\n")
+        if self.echo:
+            brief = {k: (round(v, 6) if isinstance(v, float) else v)
+                     for k, v in rec.items() if k != "time"}
+            print(brief, flush=True)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class StepTimer:
+    """Windowed steps/sec + items/sec/chip; excludes the first (compile) step."""
+
+    def __init__(self, items_per_step: int, n_chips: int = 1):
+        self.items_per_step = items_per_step
+        self.n_chips = max(n_chips, 1)
+        self._t0: float | None = None
+        self._steps = 0
+
+    def tick(self) -> None:
+        if self._t0 is None:  # first tick arms the timer (skips compile)
+            self._t0 = time.perf_counter()
+            return
+        self._steps += 1
+
+    def rates(self) -> dict[str, float]:
+        if not self._steps or self._t0 is None:
+            return {"steps_per_sec": 0.0, "items_per_sec_per_chip": 0.0}
+        dt = time.perf_counter() - self._t0
+        sps = self._steps / dt
+        return {
+            "steps_per_sec": sps,
+            "items_per_sec_per_chip": sps * self.items_per_step / self.n_chips,
+        }
+
+    def reset(self) -> None:
+        self._t0, self._steps = None, 0
+
+
+class ProfilerSession:
+    """Optional `jax.profiler` trace capture around N steps (SURVEY.md §5.1)."""
+
+    def __init__(self, log_dir: str, enabled: bool = False):
+        self.log_dir = os.path.join(log_dir, "profile")
+        self.enabled = enabled
+        self._active = False
+
+    def maybe_start(self) -> None:
+        if self.enabled and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def maybe_stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

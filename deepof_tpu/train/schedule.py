@@ -1,0 +1,24 @@
+"""Learning-rate schedules.
+
+The reference multiplies LR by `decay_factor` every `num_epochs_per_decay`
+epochs, feeding it through a placeholder (`flyingChairsTrain.py:27-33,124,
+208-209`). Here it is a pure step->lr function handed to optax, so the
+schedule state lives in the step counter and survives checkpoint/resume
+(fixing the reference deficiency of restarting the LR schedule on resume,
+SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from ..core.config import OptimConfig
+
+
+def step_decay_schedule(cfg: OptimConfig, steps_per_epoch: int):
+    """lr(step) = learning_rate * decay_factor ** (epoch // epochs_per_decay)."""
+    spe = max(steps_per_epoch, 1)
+
+    def schedule(step):
+        epoch = step // spe
+        return cfg.learning_rate * (cfg.decay_factor ** (epoch // cfg.epochs_per_decay))
+
+    return schedule

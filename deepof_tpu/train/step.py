@@ -1,0 +1,170 @@
+"""pjit train/eval step functions.
+
+One step builder serves every model family (the reference re-implements the
+session loop per dataset, SURVEY.md §2.2):
+
+  - 2-frame flow models (FlowNet-S/C, VGG16, Inception-v3): unsupervised
+    pyramid loss over (source, target);
+  - multi-frame volume models (Sintel T-volume): `pyramid_loss_multi`;
+  - two-stream action models (STsingle/STbaseline): pyramid loss + action
+    cross-entropy weighted by the finest flow weight, matching
+    `ucf101wrapFlow.py:186-188`;
+  - spatial-only classifier: cross-entropy.
+
+Data parallelism: the step is `jax.jit`-ed with the batch sharded over the
+mesh "data" axis and the state replicated; XLA inserts the gradient
+all-reduce over ICI from the sharding annotations (no hand-written psum
+needed — SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.config import ExperimentConfig, LossConfig
+from ..losses.pyramid import (
+    lrn_normalize,
+    preprocess,
+    pyramid_loss,
+    pyramid_loss_multi,
+)
+from ..parallel.mesh import batch_sharding, replicated_sharding
+from .state import TrainState
+
+Mean = tuple[float, float, float]
+
+
+def _tiled_mean(mean: Mean, channels: int) -> jnp.ndarray:
+    reps = channels // len(mean)
+    return jnp.tile(jnp.asarray(mean), reps)
+
+
+def model_losses(
+    model,
+    params,
+    batch: dict[str, jnp.ndarray],
+    mean: Mean,
+    loss_cfg: LossConfig,
+    train: bool = False,
+    dropout_rng: jax.Array | None = None,
+    smooth_border_mask: bool = False,
+    compute_dtype: Any = jnp.float32,
+) -> tuple[jnp.ndarray, dict[str, Any]]:
+    """Forward + objective. Returns (total_loss, aux dict with per-scale
+    loss dicts, finest flow, reconstruction, and optional action logits)."""
+    rngs = {"dropout": dropout_rng} if (train and dropout_rng is not None) else None
+
+    def fwd(x, **kw):
+        out = model.apply({"params": params}, x.astype(compute_dtype),
+                          rngs=rngs, **kw)
+        return jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), out)
+
+    aux: dict[str, Any] = {}
+
+    if "volume" in batch:  # multi-frame Sintel volume
+        vol = batch["volume"]
+        scaled = preprocess(vol, _tiled_mean(mean, vol.shape[-1]))
+        flows = fwd(scaled)
+        pyramid = list(zip(flows, model.flow_scales))
+        total, losses, recon = pyramid_loss_multi(pyramid, lrn_normalize(scaled), loss_cfg)
+        aux.update(losses=losses, flow=flows[0] * model.flow_scales[0], recon=recon)
+        return total, aux
+
+    # Dual-stream augmentation (reference `flyingChairsTrain_vgg.py:186-195`):
+    # the photo-augmented pair (net_*) feeds the network; the geo-only pair
+    # (source/target) feeds the photometric loss.
+    src = preprocess(batch["source"], mean)
+    tgt = preprocess(batch["target"], mean)
+    net_src = preprocess(batch["net_source"], mean) if "net_source" in batch else src
+    net_tgt = preprocess(batch["net_target"], mean) if "net_target" in batch else tgt
+    pair = jnp.concatenate([net_src, net_tgt], axis=-1)
+
+    if getattr(model, "classifier_only", False):
+        logits = fwd(src, train=train)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"])
+        total = jnp.mean(ce)
+        aux.update(logits=logits, action_loss=total)
+        return total, aux
+
+    is_two_stream = getattr(model, "has_action_head", False)
+    if is_two_stream:
+        flows, logits = fwd(pair, train=train)
+    else:
+        flows = fwd(pair)
+
+    pyramid = list(zip(flows, model.flow_scales))
+    total, losses, recon = pyramid_loss(
+        pyramid, lrn_normalize(src), lrn_normalize(tgt), loss_cfg,
+        smooth_border_mask=smooth_border_mask)
+    aux.update(losses=losses, flow=flows[0] * model.flow_scales[0], recon=recon)
+
+    if is_two_stream:
+        ce = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, batch["label"]))
+        # action loss enters with the finest flow weight (`ucf101wrapFlow.py:186-188`)
+        total = total + loss_cfg.weights[0] * ce
+        acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+        aux.update(logits=logits, action_loss=ce, accuracy=acc)
+    return total, aux
+
+
+def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
+                    smooth_border_mask: bool = False):
+    """Build the jitted, sharded train step: (state, batch) -> (state, metrics)."""
+    compute_dtype = jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16" else jnp.float32
+
+    def step(state: TrainState, batch):
+        rng, dropout_rng = jax.random.split(state.rng)
+
+        def loss_fn(params):
+            total, aux = model_losses(
+                model, params, batch, mean, cfg.loss, train=True,
+                dropout_rng=dropout_rng, smooth_border_mask=smooth_border_mask,
+                compute_dtype=compute_dtype)
+            return total, aux
+
+        (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads).replace(rng=rng)
+        metrics = {"total": total, "grad_norm": optax.global_norm(grads)}
+        if "losses" in aux:
+            for key in ("total", "Charbonnier_reconstruct", "U_loss", "V_loss"):
+                metrics[f"scale_{key}"] = jnp.stack([d[key] for d in aux["losses"]])
+        for key in ("action_loss", "accuracy"):
+            if key in aux:
+                metrics[key] = aux[key]
+        return new_state, metrics
+
+    repl, data = replicated_sharding(mesh), batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(repl, data),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_fn(model, cfg: ExperimentConfig, mean: Mean, mesh=None,
+                 smooth_border_mask: bool = False):
+    """Jitted eval forward: (params, batch) -> metrics + finest flow (already
+    multiplied by flow_scale, before the eval amplifier/clip protocol which
+    is host-side in `evaluate.py`). Reuses the training graph — the gen-1
+    `testOF.py` design, not gen-2's graph-rebuilding evaluateNet
+    (SURVEY.md §3.2)."""
+
+    def fwd(params, batch):
+        total, aux = model_losses(
+            model, params, batch, mean, cfg.loss, train=False,
+            smooth_border_mask=smooth_border_mask)
+        out = {"total": total}
+        for key in ("flow", "recon", "logits"):
+            if key in aux:
+                out[key] = aux[key]
+        return out
+
+    if mesh is None:
+        return jax.jit(fwd)
+    return jax.jit(fwd, in_shardings=(replicated_sharding(mesh), batch_sharding(mesh)))

@@ -1,0 +1,84 @@
+"""Augmentation tests: determinism under fixed keys, identity/flip exactness
+of the affine path, photometric range preservation, dual-stream batch keys."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepof_tpu.core.config import DataConfig
+from deepof_tpu.data import (
+    apply_geo,
+    augment_batch,
+    identity_geo_params,
+    make_augment_fn,
+    photometric_augment,
+    sample_geo_params,
+)
+
+
+@pytest.fixture
+def images(rng):
+    return jnp.asarray(rng.rand(2, 16, 24, 3).astype(np.float32) * 255.0)
+
+
+def test_apply_geo_identity(images):
+    out = apply_geo(images, identity_geo_params(2))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(images), atol=1e-3)
+
+
+def test_apply_geo_flip(images):
+    params = identity_geo_params(2)
+    params["flip"] = jnp.asarray([True, False])
+    out = np.asarray(apply_geo(images, params))
+    np.testing.assert_allclose(out[0], np.asarray(images)[0, :, ::-1], atol=1e-3)
+    np.testing.assert_allclose(out[1], np.asarray(images)[1], atol=1e-3)
+
+
+def test_apply_geo_translation(images):
+    params = identity_geo_params(2)
+    params["tx"] = jnp.asarray([0.25, 0.0])  # shift right by 6 of 24 cols
+    out = np.asarray(apply_geo(images, params))
+    np.testing.assert_allclose(out[0][:, 6:], np.asarray(images)[0][:, :-6],
+                               atol=1e-3)
+
+
+def test_geo_params_deterministic():
+    p1 = sample_geo_params(jax.random.PRNGKey(7), 4)
+    p2 = sample_geo_params(jax.random.PRNGKey(7), 4)
+    for k in p1:
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+    assert float(jnp.max(p1["scale"])) <= 2.0
+    assert float(jnp.min(p1["scale"])) >= 0.9
+
+
+def test_photometric_identical_params_both_frames(images):
+    a, b = photometric_augment(jax.random.PRNGKey(0), images, images)
+    # same input + same per-sample params -> near-identical outputs (only the
+    # additive noise differs between frames)
+    assert float(jnp.mean(jnp.abs(a - b))) < 255.0 * 0.05
+    assert float(jnp.min(a)) >= 0.0 and float(jnp.max(a)) <= 255.0
+
+
+def test_augment_batch_dual_stream(images):
+    batch = {"source": images, "target": images,
+             "flow": jnp.zeros((2, 16, 24, 2)), "label": jnp.zeros((2,), jnp.int32)}
+    out = augment_batch(batch, jax.random.PRNGKey(3), geo=True, photo=True)
+    assert {"source", "target", "net_source", "net_target", "flow", "label"} <= set(out)
+    # geo pair differs from the photo pair; flow passes through untouched
+    assert not np.allclose(np.asarray(out["source"]), np.asarray(out["net_source"]))
+    np.testing.assert_array_equal(np.asarray(out["flow"]), np.asarray(batch["flow"]))
+    # deterministic under the same key
+    out2 = augment_batch(batch, jax.random.PRNGKey(3), geo=True, photo=True)
+    np.testing.assert_allclose(np.asarray(out["net_source"]),
+                               np.asarray(out2["net_source"]))
+
+
+def test_make_augment_fn_numpy_roundtrip(rng):
+    cfg = DataConfig(augment_geo=True, augment_photo=True)
+    fn = make_augment_fn(cfg)
+    batch = {"source": rng.rand(2, 16, 16, 3).astype(np.float32) * 255,
+             "target": rng.rand(2, 16, 16, 3).astype(np.float32) * 255}
+    out = fn(batch, 123)
+    assert isinstance(out["net_source"], np.ndarray)
+    assert out["net_source"].shape == (2, 16, 16, 3)

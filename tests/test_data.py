@@ -1,0 +1,175 @@
+"""Data pipeline tests: dataset index/split semantics against synthetic
+on-disk fixtures, batch shapes, prefetcher overlap and error propagation."""
+
+import os
+
+import numpy as np
+import cv2
+import pytest
+
+from deepof_tpu.core.config import DataConfig
+from deepof_tpu.data import (
+    FlyingChairsData,
+    Prefetcher,
+    SintelData,
+    SyntheticData,
+    UCF101Data,
+    build_dataset,
+)
+from deepof_tpu.io.flo import write_flo
+
+
+def _write_ppm(path, h=32, w=48, seed=0):
+    rng = np.random.RandomState(seed)
+    cv2.imwrite(str(path), rng.randint(0, 255, (h, w, 3), np.uint8))
+
+
+def _make_flyingchairs(root, n=10):
+    for i in range(1, n + 1):
+        sid = f"{i:05d}"
+        _write_ppm(root / f"{sid}_img1.ppm", seed=i)
+        _write_ppm(root / f"{sid}_img2.ppm", seed=i + 1000)
+        write_flo(root / f"{sid}_flow.flo",
+                  np.random.RandomState(i).rand(32, 48, 2).astype(np.float32))
+    # split file: markers 1=train, 2=val; last 3 are val
+    markers = ["1"] * (n - 3) + ["2"] * 3
+    (root / "FlyingChairs_train_val.txt").write_text("\n".join(markers) + "\n")
+
+
+@pytest.fixture
+def chairs_root(tmp_path):
+    _make_flyingchairs(tmp_path)
+    return tmp_path
+
+
+def test_flyingchairs_split_and_shapes(chairs_root):
+    cfg = DataConfig(dataset="flyingchairs", data_path=str(chairs_root),
+                     image_size=(24, 40), gt_size=(32, 48), batch_size=2)
+    ds = FlyingChairsData(cfg)
+    assert ds.num_train == 7 and ds.num_val == 3
+    b = ds.sample_train(2, iteration=0)
+    assert b["source"].shape == (2, 24, 40, 3)
+    assert b["flow"].shape == (2, 32, 48, 2)  # GT stays native
+    # sequential batching is deterministic
+    b2 = ds.sample_train(2, iteration=0)
+    np.testing.assert_array_equal(b["source"], b2["source"])
+    v = ds.sample_val(2, 0)
+    assert v["source"].shape[0] == 2
+
+
+def test_flyingchairs_fallback_split(tmp_path):
+    _make_flyingchairs(tmp_path, n=5)
+    os.remove(tmp_path / "FlyingChairs_train_val.txt")
+    cfg = DataConfig(dataset="flyingchairs", data_path=str(tmp_path),
+                     image_size=(24, 40))
+    ds = FlyingChairsData(cfg)
+    assert ds.num_train == 4 and ds.num_val == 1  # both splits non-empty
+    assert ds.sample_train(2, rng=np.random.RandomState(0))["source"].shape[0] == 2
+
+
+def _make_sintel(root, clips=("alley_1", "bamboo_2"), frames=6):
+    for clip in clips:
+        img_dir = root / "training" / "final" / clip
+        flow_dir = root / "training" / "flow" / clip
+        img_dir.mkdir(parents=True)
+        flow_dir.mkdir(parents=True)
+        for f in range(1, frames + 1):
+            _write_ppm(img_dir / f"frame_{f:04d}.png", h=32, w=64, seed=f)
+            if f < frames:
+                write_flo(flow_dir / f"frame_{f:04d}.flo",
+                          np.ones((32, 64, 2), np.float32) * f)
+
+
+def test_sintel_windows_and_volume(tmp_path):
+    _make_sintel(tmp_path)
+    cfg = DataConfig(dataset="sintel", data_path=str(tmp_path),
+                     image_size=(32, 64), gt_size=(32, 64), time_step=3,
+                     sintel_pass="final")
+    ds = SintelData(cfg)
+    # 2 clips x (6-3+1) windows = 8 windows; val = first of each clip + pads
+    assert len(ds.windows) == 8
+    assert ds.num_val == min(24, 4)  # 2 first windows + 2 second windows
+    b = ds.sample_train(2, rng=np.random.RandomState(0))
+    assert b["volume"].shape == (2, 32, 64, 9)  # 3T channels
+    assert b["flow"].shape == (2, 32, 64, 4)  # 2(T-1)
+    v = ds.sample_val(2, 0)
+    assert v["volume"].shape[-1] == 9
+
+
+def test_sintel_crop(tmp_path):
+    _make_sintel(tmp_path)
+    cfg = DataConfig(dataset="sintel", data_path=str(tmp_path),
+                     image_size=(32, 64), crop_size=(16, 32), time_step=2,
+                     sintel_pass="final")
+    ds = SintelData(cfg)
+    b = ds.sample_train(1, rng=np.random.RandomState(0))
+    assert b["volume"].shape == (1, 16, 32, 6)
+
+
+def _make_ucf101(root, classes=("ApplyEyeMakeup", "Archery"), n_frames=4):
+    for cls in classes:
+        for g, c in [(8, 1), (9, 1), (3, 1)]:  # groups 8,9 train; 3 val
+            clip = root / "frames" / cls / f"v_{cls}_g{g:02d}_c{c:02d}"
+            clip.mkdir(parents=True)
+            for f in range(n_frames):
+                _write_ppm(clip / f"frame{f:03d}.jpg", h=32, w=40, seed=f)
+
+
+def test_ucf101_split_and_batches(tmp_path):
+    _make_ucf101(tmp_path)
+    cfg = DataConfig(dataset="ucf101", data_path=str(tmp_path),
+                     image_size=(24, 32), batch_size=2)
+    ds = UCF101Data(cfg)
+    assert ds.num_train == 4 and ds.num_val == 2  # 2 classes x (2 train, 1 val)
+    b = ds.sample_train(2, rng=np.random.RandomState(0))
+    assert b["source"].shape == (2, 24, 32, 3)
+    assert b["label"].shape == (2,)
+    assert len(set(b["label"])) == 2  # distinct classes
+    v = ds.sample_val(2, 1)
+    assert len(set(v["label"])) == 1  # one class per val batch
+
+
+def test_synthetic_flow_consistency():
+    cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=2)
+    ds = SyntheticData(cfg, max_shift=3)
+    b = ds.sample_train(2, iteration=0)
+    # target shifted by (u,v): source[y+v, x+u] == target[y, x]
+    u, v = int(b["flow"][0, 0, 0, 0]), int(b["flow"][0, 0, 0, 1])
+    h, w = 32, 48
+    ys = slice(max(0, -v), min(h, h - v))
+    xs = slice(max(0, -u), min(w, w - u))
+    src_shift = b["source"][0][max(0, v) : h + min(0, v), max(0, u) : w + min(0, u)]
+    np.testing.assert_allclose(src_shift, b["target"][0][ys, xs], atol=1e-4)
+
+
+def test_build_dataset_dispatch():
+    cfg = DataConfig(dataset="synthetic", image_size=(16, 16))
+    assert isinstance(build_dataset(cfg), SyntheticData)
+    with pytest.raises(KeyError):
+        build_dataset(DataConfig(dataset="nope"))
+
+
+def test_prefetcher_produces_and_closes():
+    cfg = DataConfig(dataset="synthetic", image_size=(16, 16), batch_size=2)
+    ds = SyntheticData(cfg)
+    calls = {"n": 0}
+
+    def produce():
+        calls["n"] += 1
+        return ds.sample_train(2, iteration=calls["n"])
+
+    pf = Prefetcher(produce, depth=2)
+    b1, b2 = pf.get(), pf.get()
+    assert b1["source"].shape == (2, 16, 16, 3)
+    assert not np.array_equal(b1["source"], b2["source"])
+    pf.close()
+
+
+def test_prefetcher_propagates_errors():
+    def boom():
+        raise ValueError("decode failed")
+
+    pf = Prefetcher(boom, depth=1)
+    with pytest.raises(ValueError, match="decode failed"):
+        pf.get()
+    pf.close()
