@@ -1,0 +1,88 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.json): FlyingChairs image-pairs/sec/chip on the full
+training step (forward + unsupervised pyramid loss + backward + Adam) of
+the flagship Inception-v3 flow model at the reference's 320x448 input
+(`deepOF.py:22`), bfloat16 compute.
+
+The reference publishes no throughput numbers (BASELINE.md); the baseline
+anchor is a self-measured first run stored in `BENCH_BASELINE.json`. When
+absent, vs_baseline = 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(model_name: str = "inception_v3", batch: int = 16,
+          image_size=(320, 448), steps: int = 20, warmup: int = 3) -> dict:
+    from deepof_tpu.core.config import (
+        DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+    from deepof_tpu.train.step import make_train_step
+
+    h, w = image_size
+    n_chips = len(jax.devices())
+    cfg = ExperimentConfig(
+        name="bench",
+        model=model_name,
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=1.6e-5),
+        data=DataConfig(dataset="synthetic", image_size=(h, w), gt_size=(h, w),
+                        batch_size=batch),
+        train=TrainConfig(seed=0, compute_dtype="bfloat16"),
+    )
+    mesh = build_mesh(cfg.mesh)
+    model = build_model(cfg.model, dtype=jnp.bfloat16)
+    tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
+    state = create_train_state(model, jnp.zeros((batch, h, w, 6)), tx, seed=0)
+    ds = SyntheticData(cfg.data)
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    b = jax.device_put(ds.sample_train(batch, iteration=0), batch_sharding(mesh))
+
+    for _ in range(warmup):
+        state, metrics = step(state, b)
+    jax.block_until_ready(metrics["total"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, b)
+    jax.block_until_ready(metrics["total"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = steps * batch / dt
+    per_chip = pairs_per_sec / n_chips
+    assert np.isfinite(float(jax.device_get(metrics["total"])))
+    return {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
+            "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt}
+
+
+def main() -> None:
+    res = bench()
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("pairs_per_sec_per_chip")
+        if base:
+            vs = res["pairs_per_sec_per_chip"] / base
+    print(json.dumps({
+        "metric": "flyingchairs_train_pairs_per_sec_per_chip",
+        "value": round(res["pairs_per_sec_per_chip"], 2),
+        "unit": "image-pairs/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Single CLI entry — replaces the reference's per-dataset entry scripts
+(`deepOF.py`, `deepOF_fc.py`, `version1/deepOF.py`, SURVEY.md §2.1) and the
+edit-a-boolean dataset dispatch (`deepOF.py:8-10`).
+
+Usage:
+    python -m deepof_tpu train --preset flyingchairs --data-path /data/fc
+    python -m deepof_tpu eval  --preset sintel --data-path /data/sintel \
+        --log-dir /tmp/run1          # restores latest checkpoint
+    python -m deepof_tpu bench --model inception_v3
+
+Any config field can be overridden with --set section.field=value, e.g.
+    --set optim.learning_rate=1e-4 --set train.num_epochs=10
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import sys
+
+from .core.config import PRESETS, ExperimentConfig, get_config
+
+
+def _parse_value(raw: str):
+    try:
+        return ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        return raw
+
+
+def _apply_override(cfg: ExperimentConfig, dotted: str, raw: str) -> ExperimentConfig:
+    """Set `section.field=value` (or a top-level `field=value`) on the frozen
+    config tree, returning a new config."""
+    value = _parse_value(raw)
+    parts = dotted.split(".")
+    if len(parts) == 1:
+        return cfg.replace(**{parts[0]: value})
+    if len(parts) != 2:
+        raise SystemExit(f"bad override {dotted!r}: use section.field=value")
+    section, field = parts
+    sub = getattr(cfg, section)
+    if not hasattr(sub, field):
+        raise SystemExit(f"unknown config field {dotted!r}")
+    return cfg.replace(**{section: dataclasses.replace(sub, **{field: value})})
+
+
+def _build_cfg(args) -> ExperimentConfig:
+    cfg = get_config(args.preset)
+    if args.model:
+        cfg = cfg.replace(model=args.model)
+    if args.data_path:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, data_path=args.data_path))
+    if args.log_dir:
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train, log_dir=args.log_dir))
+    for item in args.set or []:
+        if "=" not in item:
+            raise SystemExit(f"bad --set {item!r}: use section.field=value")
+        dotted, raw = item.split("=", 1)
+        cfg = _apply_override(cfg, dotted, raw)
+    return cfg
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", default="flyingchairs", choices=sorted(PRESETS))
+    p.add_argument("--model", default=None)
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--set", action="append", metavar="SECTION.FIELD=VALUE")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="deepof_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="train a model")
+    _add_common(p_train)
+    p_train.add_argument("--epochs", type=int, default=None)
+    p_train.add_argument("--max-steps", "--steps", dest="max_steps",
+                         type=int, default=None)
+    p_train.add_argument("--profile", action="store_true")
+    p_train.add_argument("--synthetic", action="store_true",
+                         help="swap in the synthetic dataset at small shapes "
+                              "(smoke tests; no data on disk needed)")
+
+    p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
+    _add_common(p_eval)
+    p_eval.add_argument("--dump-visuals", action="store_true")
+
+    p_cfg = sub.add_parser("config", help="print the resolved config")
+    _add_common(p_cfg)
+
+    p_bench = sub.add_parser("bench", help="throughput benchmark")
+    p_bench.add_argument("--model", default="inception_v3")
+    p_bench.add_argument("--batch", type=int, default=16)
+    p_bench.add_argument("--steps", type=int, default=20)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "bench":
+        sys.path.insert(0, ".")
+        import bench as bench_mod
+
+        res = bench_mod.bench(model_name=args.model, batch=args.batch,
+                              steps=args.steps)
+        print(json.dumps(res))
+        return 0
+
+    cfg = _build_cfg(args)
+    if getattr(args, "synthetic", False):
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, dataset="synthetic", image_size=(64, 64),
+            gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
+            train=dataclasses.replace(cfg.train, eval_batch_size=8,
+                                      eval_amplifier=1.0))
+    if args.cmd == "config":
+        print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
+        return 0
+
+    from .train.loop import Trainer
+
+    trainer = Trainer(cfg, profile=getattr(args, "profile", False))
+    if args.cmd == "train":
+        out = trainer.fit(num_epochs=args.epochs, max_steps=args.max_steps)
+        print(json.dumps({k: float(v) for k, v in out.items()}))
+    else:  # eval
+        res = trainer.evaluate(dump=args.dump_visuals)
+        print(json.dumps({k: float(v) for k, v in res.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

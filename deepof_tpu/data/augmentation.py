@@ -147,14 +147,16 @@ def augment_batch(batch: dict, key: jax.Array, geo: bool = True,
 
 
 def make_augment_fn(cfg: DataConfig):
-    """Host-callable augmenter: (numpy batch, int seed) -> augmented batch."""
+    """Host-callable augmenter: (numpy batch, int seed) -> augmented batch.
+
+    Image tensors stay on device — the downstream `device_put` with the
+    batch sharding reshards them device-to-device instead of forcing a
+    device->host->device roundtrip on the hot input path.
+    """
     geo, photo = cfg.augment_geo, cfg.augment_photo
 
     def fn(batch: dict, seed) -> dict:
         key = jax.random.PRNGKey(int(seed))
-        out = augment_batch(batch, key, geo=geo, photo=photo)
-        return {k: np.asarray(v) if k in ("source", "target", "net_source",
-                                          "net_target") else v
-                for k, v in out.items()}
+        return dict(augment_batch(batch, key, geo=geo, photo=photo))
 
     return fn

@@ -23,6 +23,7 @@ Split semantics:
 
 from __future__ import annotations
 
+import collections
 import os
 import re
 from typing import Protocol
@@ -74,20 +75,29 @@ class Dataset(Protocol):
 
 
 class _DecodedCache:
-    """Unbounded decoded-image cache for the small benchmark datasets
-    (SURVEY.md §7.3.4: per-step host decode starves a TPU)."""
+    """Byte-bounded decoded-image cache (SURVEY.md §7.3.4: per-step host
+    decode starves a TPU). LRU eviction keeps host RAM bounded even on the
+    full 22k-pair FlyingChairs set."""
 
-    def __init__(self, enabled: bool, reader):
+    def __init__(self, enabled: bool, reader, max_bytes: int = 4 << 30):
         self._enabled = enabled
         self._reader = reader
-        self._store: dict[str, np.ndarray] = {}
+        self._max_bytes = max_bytes
+        self._bytes = 0
+        self._store: collections.OrderedDict[str, np.ndarray] = (
+            collections.OrderedDict())
 
     def __call__(self, path: str) -> np.ndarray:
         if not self._enabled:
             return self._reader(path)
-        hit = self._store.get(path)
+        hit = self._store.pop(path, None)
         if hit is None:
-            hit = self._store[path] = self._reader(path)
+            hit = self._reader(path)
+            self._bytes += hit.nbytes
+            while self._bytes > self._max_bytes and self._store:
+                _, old = self._store.popitem(last=False)
+                self._bytes -= old.nbytes
+        self._store[path] = hit  # (re-)insert as most recent
         return hit
 
 
@@ -333,8 +343,11 @@ class SyntheticData:
         u, v = rng.randint(-self._max_shift, self._max_shift + 1, 2)
         src = img[8 : 8 + h, 8 : 8 + w]
         tgt = img[8 + v : 8 + v + h, 8 + u : 8 + u + w]
+        # tgt[y, x] == src[y+v, x+u], so source content at p sits at
+        # p + (-u, -v) in the target: GT flow (and the minimizer of the
+        # backward-warp loss, recon[p] = tgt[p + f] == src[p]) is (-u, -v).
         flow = np.broadcast_to(
-            np.asarray([u, v], np.float32), (h, w, 2)
+            np.asarray([-u, -v], np.float32), (h, w, 2)
         ).copy()
         return src, tgt, flow
 

@@ -69,6 +69,7 @@ class Trainer:
         self.train_step = make_train_step(self.model, cfg, self.dataset.mean,
                                           self.mesh, smooth_border)
         self.eval_fn = make_eval_fn(self.model, cfg, self.dataset.mean,
+                                    mesh=self.mesh,
                                     smooth_border_mask=smooth_border)
         self._augment = None  # set by enable_augmentation()
 
@@ -147,8 +148,10 @@ class Trainer:
                 if eval_due:
                     last_eval = self.evaluate(dump=cfg.train.dump_visuals)
                     self.logger.log("eval", step + 1, epoch=epoch, **last_eval)
+                    timer.pause()  # eval time is not training throughput
                 if end_of_epoch and epoch % cfg.train.ckpt_every_epochs == 0:
                     self.ckpt.save(self.state)
+                    timer.pause()
             self.profiler.maybe_stop()
             self.ckpt.save(self.state)
         finally:

@@ -45,32 +45,42 @@ class MetricsLogger:
 
 
 class StepTimer:
-    """Windowed steps/sec + items/sec/chip; excludes the first (compile) step."""
+    """Cumulative steps/sec + items/sec/chip over *training* time only.
+
+    The first tick after construction or `pause()` only arms the timer, so
+    the compile step and any paused-over work (eval sweeps, checkpoint
+    saves) are excluded from the rates.
+    """
 
     def __init__(self, items_per_step: int, n_chips: int = 1):
         self.items_per_step = items_per_step
         self.n_chips = max(n_chips, 1)
-        self._t0: float | None = None
+        self._last: float | None = None
+        self._elapsed = 0.0
         self._steps = 0
 
     def tick(self) -> None:
-        if self._t0 is None:  # first tick arms the timer (skips compile)
-            self._t0 = time.perf_counter()
-            return
-        self._steps += 1
+        now = time.perf_counter()
+        if self._last is not None:
+            self._elapsed += now - self._last
+            self._steps += 1
+        self._last = now
+
+    def pause(self) -> None:
+        """Exclude wall time until the next tick (eval / checkpoint)."""
+        self._last = None
 
     def rates(self) -> dict[str, float]:
-        if not self._steps or self._t0 is None:
+        if not self._steps or self._elapsed <= 0.0:
             return {"steps_per_sec": 0.0, "items_per_sec_per_chip": 0.0}
-        dt = time.perf_counter() - self._t0
-        sps = self._steps / dt
+        sps = self._steps / self._elapsed
         return {
             "steps_per_sec": sps,
             "items_per_sec_per_chip": sps * self.items_per_step / self.n_chips,
         }
 
     def reset(self) -> None:
-        self._t0, self._steps = None, 0
+        self._last, self._elapsed, self._steps = None, 0.0, 0
 
 
 class ProfilerSession:

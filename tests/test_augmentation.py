@@ -74,11 +74,16 @@ def test_augment_batch_dual_stream(images):
                                np.asarray(out2["net_source"]))
 
 
-def test_make_augment_fn_numpy_roundtrip(rng):
+def test_make_augment_fn_stays_on_device(rng):
+    """Augmented tensors stay as jax arrays (no host roundtrip; the
+    prefetcher device_puts them straight to the mesh sharding)."""
+    import jax
+
     cfg = DataConfig(augment_geo=True, augment_photo=True)
     fn = make_augment_fn(cfg)
     batch = {"source": rng.rand(2, 16, 16, 3).astype(np.float32) * 255,
              "target": rng.rand(2, 16, 16, 3).astype(np.float32) * 255}
     out = fn(batch, 123)
-    assert isinstance(out["net_source"], np.ndarray)
+    assert isinstance(out["net_source"], jax.Array)
     assert out["net_source"].shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out["net_source"])).all()

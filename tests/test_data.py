@@ -130,16 +130,25 @@ def test_ucf101_split_and_batches(tmp_path):
 
 
 def test_synthetic_flow_consistency():
+    """GT flow must be the minimizer of the backward-warp loss:
+    backward_warp(target, flow) == source (away from borders)."""
+    from deepof_tpu.ops.warp import backward_warp
+
     cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=2)
     ds = SyntheticData(cfg, max_shift=3)
     b = ds.sample_train(2, iteration=0)
-    # target shifted by (u,v): source[y+v, x+u] == target[y, x]
-    u, v = int(b["flow"][0, 0, 0, 0]), int(b["flow"][0, 0, 0, 1])
+    recon = np.asarray(backward_warp(b["target"], b["flow"]))
+    m = 4  # exclude the clip-at-border band (|flow| <= max_shift)
+    np.testing.assert_allclose(recon[:, m:-m, m:-m], b["source"][:, m:-m, m:-m],
+                               atol=1e-3)
+    # pixel-level relation: source[y, x] == target[y + fv, x + fu]
+    fu, fv = int(b["flow"][0, 0, 0, 0]), int(b["flow"][0, 0, 0, 1])
     h, w = 32, 48
-    ys = slice(max(0, -v), min(h, h - v))
-    xs = slice(max(0, -u), min(w, w - u))
-    src_shift = b["source"][0][max(0, v) : h + min(0, v), max(0, u) : w + min(0, u)]
-    np.testing.assert_allclose(src_shift, b["target"][0][ys, xs], atol=1e-4)
+    src_part = b["source"][0][max(0, -fv) : h + min(0, -fv),
+                              max(0, -fu) : w + min(0, -fu)]
+    tgt_part = b["target"][0][max(0, fv) : h + min(0, fv),
+                              max(0, fu) : w + min(0, fu)]
+    np.testing.assert_allclose(src_part, tgt_part, atol=1e-4)
 
 
 def test_build_dataset_dispatch():
