@@ -18,12 +18,17 @@ import argparse
 import ast
 import dataclasses
 import json
+import os
 import sys
 
 from .core.config import PRESETS, ExperimentConfig, get_config
 
 
 def _parse_value(raw: str):
+    if raw.lower() in ("true", "false"):  # accept lowercase bools
+        return raw.lower() == "true"
+    if raw.lower() in ("none", "null"):
+        return None
     try:
         return ast.literal_eval(raw)
     except (ValueError, SyntaxError):
@@ -99,7 +104,9 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     if args.cmd == "bench":
-        sys.path.insert(0, ".")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
         import bench as bench_mod
 
         res = bench_mod.bench(model_name=args.model, batch=args.batch,

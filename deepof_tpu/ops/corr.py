@@ -27,8 +27,19 @@ def correlation(
     f2: jnp.ndarray,
     max_disp: int = 20,
     stride: int = 2,
+    impl: str = "auto",
 ) -> jnp.ndarray:
-    """f1, f2: (B, H, W, C) -> (B, H, W, (2K+1)**2), K = max_disp // stride."""
+    """f1, f2: (B, H, W, C) -> (B, H, W, (2K+1)**2), K = max_disp // stride.
+
+    impl: "auto" picks the fused Pallas kernel on TPU (one HBM read of f2
+    instead of one per displacement) and the XLA sweep elsewhere.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        from .pallas.corr import correlation_pallas
+
+        return correlation_pallas(f1, f2, max_disp, stride)
     b, h, w, c = f1.shape
     k = max_disp // stride
     n = 2 * k + 1

@@ -1,0 +1,23 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Kernel inventory (and why each op is/isn't a kernel):
+
+  - `corr.py` — FlowNet-C correlation / cost volume. The (2K+1)^2
+    displacement sweep re-reads the second feature map hundreds of times;
+    the XLA `dynamic_slice` formulation pays HBM traffic per displacement,
+    while the kernel holds one haloed row-window of f2 in VMEM and sweeps
+    all displacements from on-chip memory.
+
+  - The bilinear warp (`ops/warp.py`) deliberately stays an XLA gather:
+    flow magnitude is unbounded (the reference clips eval flow at +-300 px,
+    `flyingChairsTrain.py:265`), so windowed VMEM loads cannot be sized
+    statically without changing semantics, and a one-hot matmul
+    decomposition is impossible for jointly spatially-varying (u, v) index
+    fields. XLA lowers the single fused `take_along_axis` gather natively;
+    the surrounding Charbonnier/smoothness elementwise+reduce work fuses
+    into it.
+"""
+
+from .corr import correlation_pallas
+
+__all__ = ["correlation_pallas"]
