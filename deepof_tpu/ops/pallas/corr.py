@@ -152,21 +152,6 @@ def _partitioned_fwd(max_disp: int, stride: int, tile_h: int, interpret: bool):
     return fwd
 
 
-def _xla_sweep(f1, f2, max_disp, stride):
-    """XLA displacement sweep (same math; used for the VJP)."""
-    b, h, w, c = f1.shape
-    k = max_disp // stride
-    pad = k * stride
-    f2p = jnp.pad(f2, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
-
-    def one(off):
-        sl = lax.dynamic_slice(f2p, (0, off[0], off[1], 0), (b, h, w, c))
-        return jnp.mean(f1 * sl, axis=-1)
-
-    maps = jax.vmap(one)(_sweep_offsets(2 * k + 1, stride))
-    return jnp.moveaxis(maps, 0, -1)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def correlation_pallas(f1, f2, max_disp: int = 20, stride: int = 2,
                        tile_h: int = 8, interpret: bool = False):

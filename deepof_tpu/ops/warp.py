@@ -77,12 +77,18 @@ def backward_warp_volume(volume: jnp.ndarray, flows: jnp.ndarray) -> jnp.ndarray
     returns (B, H, W, 3*(T-1)) — channel c is gathered from volume channel
     c+3 using flow channels (2*(c//3), 2*(c//3)+1).
     """
+    from ..parallel.spatial import pair_axis_constraint
+
     b, h, w, c3t = volume.shape
     t = c3t // 3
     frames = volume.reshape(b, h, w, t, 3)
     pairs = flows.reshape(b, h, w, t - 1, 2)
-    # fold the pair axis into batch: warp all (T-1) next-frames at once
-    nxt = jnp.moveaxis(frames[..., 1:, :], 3, 1).reshape(b * (t - 1), h, w, 3)
-    flw = jnp.moveaxis(pairs, 3, 1).reshape(b * (t - 1), h, w, 2)
+    # Fold the pair axis into batch: warp all (T-1) next-frames at once, and
+    # shard the folded axis over ("data", "time") so the independent pair
+    # warps run pair-parallel across the mesh (SURVEY.md §5.7a).
+    nxt = pair_axis_constraint(
+        jnp.moveaxis(frames[..., 1:, :], 3, 1).reshape(b * (t - 1), h, w, 3))
+    flw = pair_axis_constraint(
+        jnp.moveaxis(pairs, 3, 1).reshape(b * (t - 1), h, w, 2))
     rec = backward_warp(nxt, flw).reshape(b, t - 1, h, w, 3)
     return jnp.moveaxis(rec, 1, 3).reshape(b, h, w, 3 * (t - 1))

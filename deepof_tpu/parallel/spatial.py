@@ -1,0 +1,154 @@
+"""Spatial context parallelism + temporal pair parallelism.
+
+The reference is single-GPU (`tf.device('/gpu:0')`, `flyingChairsTrain.py:99`)
+with no parallelism of any kind; these are the TPU-native long-context
+equivalents (SURVEY.md §5.7):
+
+  - **Spatial CP** ("spatial" mesh axis): image batches are sharded over H
+    with `P(("data",), "spatial")`. Convolutions under `jit` are then
+    spatially partitioned by GSPMD, which inserts the boundary halo
+    exchanges itself — the idiomatic formulation of the ring/halo pattern
+    (annotate shardings, let XLA place collectives on ICI). This is what
+    makes high-resolution flow (e.g. Sintel 436x1024 and beyond) scale
+    past one chip's HBM.
+
+  - **Explicit halo exchange** (`halo_exchange`): the `lax.ppermute`
+    neighbor ring, for custom ops inside `shard_map` where GSPMD cannot
+    infer the halo (e.g. windowed ops with data-dependent reach).
+
+  - **Temporal pair parallelism** ("time" mesh axis): the Sintel T-frame
+    volume loss warps T-1 consecutive pairs independently
+    (`sintelWrapFlow.py:539-577` semantics); folding the pair axis into
+    batch and sharding it over ("data", "time") spreads the warp/
+    Charbonnier work across the mesh.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Trace-time mesh stack: `jax.sharding.get_abstract_mesh()` is EMPTY inside
+# plain `jax.jit` tracing (even with in_shardings), so sharding constraints
+# need the concrete mesh threaded to them explicitly. The step builders wrap
+# the loss computation in `mesh_context(mesh)`; ops deep in the call tree
+# (e.g. the folded pair axis inside `backward_warp_volume`) read it via
+# `current_mesh()` at trace time.
+_MESH_STACK: list[Mesh] = []
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh | None):
+    if mesh is None:
+        yield
+        return
+    _MESH_STACK.append(mesh)
+    try:
+        yield
+    finally:
+        _MESH_STACK.pop()
+
+
+def current_mesh() -> Mesh | None:
+    return _MESH_STACK[-1] if _MESH_STACK else None
+
+
+def image_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, H, W, C) batches: batch over "data", height over "spatial"."""
+    return NamedSharding(mesh, P("data", "spatial"))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for image batches on this mesh (H sharded only when
+    the spatial axis is populated)."""
+    if mesh.shape.get("spatial", 1) > 1:
+        return P("data", "spatial")
+    return P("data")
+
+
+# Spatial CP contract: every pyramid level must keep >= 2 rows per spatial
+# shard. The deepest encoders downsample H by 2^6 = 64; letting a level
+# collapse below one row per shard trips a degenerate GSPMD halo backward
+# that mis-scales those layers' gradients (verified empirically: conv6/pr6
+# grads come back x4 when H/64 < spatial). 128 = 64 * 2 rows.
+MIN_H_PER_SPATIAL_SHARD = 128
+
+
+def constrain_batch(batch: dict, mesh: Mesh | None = None) -> dict:
+    """Apply the spatial-CP sharding constraint to every image-like leaf
+    (rank >= 4: (B, H, W, C) images, volumes, GT flows) of a batch dict.
+
+    With a mesh whose "spatial" axis is populated, GSPMD reshards H over it
+    and spatially partitions all downstream convolutions (halo exchanges
+    inserted by the compiler). No-op otherwise, when H does not divide, or
+    when H is too small for the contract above (spatial CP is a
+    high-resolution feature; at low res it would only lose to pure DP).
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or mesh.shape.get("spatial", 1) <= 1:
+        return batch
+    spatial = mesh.shape["spatial"]
+    sharding = NamedSharding(mesh, P(("data",), "spatial"))
+
+    def put(v):
+        if (getattr(v, "ndim", 0) >= 4 and v.shape[1] % spatial == 0
+                and v.shape[1] >= MIN_H_PER_SPATIAL_SHARD * spatial):
+            return lax.with_sharding_constraint(v, sharding)
+        return v
+
+    return {k: put(v) for k, v in batch.items()}
+
+
+def pair_axis_constraint(x: jnp.ndarray) -> jnp.ndarray:
+    """Constrain a (B*(T-1), H, W, C) folded pair-axis array to shard over
+    ("data", "time") so the T-1 per-pair warps run pair-parallel.
+
+    No-op outside a `mesh_context` or when the time axis is unpopulated or
+    does not divide the folded axis.
+    """
+    mesh = current_mesh()
+    if mesh is None or mesh.shape.get("time", 1) <= 1:
+        return x
+    shards = mesh.shape["time"] * mesh.shape.get("data", 1)
+    if x.shape[0] % shards:
+        return x
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("data", "time"),)))
+
+
+def halo_exchange(x: jnp.ndarray, halo: int, axis_name: str = "spatial",
+                  axis: int = 0) -> jnp.ndarray:
+    """Pad a per-shard block with `halo` rows from each ring neighbor.
+
+    Inside `shard_map` over `axis_name`: sends this shard's boundary rows
+    to both neighbors via two `lax.ppermute` rings (the ICI-neighbor
+    pattern) and concatenates the received halos. Edge shards receive
+    zeros (clip-at-border ops should clamp indices instead of reading the
+    zero halo).
+
+    x: (..., H_shard, ...) -> (..., H_shard + 2*halo, ...) along `axis`.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    def take(arr, sl):
+        ix = [slice(None)] * arr.ndim
+        ix[axis] = sl
+        return arr[tuple(ix)]
+
+    top = take(x, slice(0, halo))  # first rows -> previous neighbor
+    bot = take(x, slice(x.shape[axis] - halo, x.shape[axis]))
+
+    fwd = [(i, (i + 1) % n) for i in range(n)]  # bottom rows travel down
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    from_prev = lax.ppermute(bot, axis_name, fwd)  # neighbor above's bottom
+    from_next = lax.ppermute(top, axis_name, bwd)  # neighbor below's top
+
+    zero = jnp.zeros_like(top)
+    from_prev = jnp.where(idx == 0, zero, from_prev)  # ring wrap -> zeros
+    from_next = jnp.where(idx == n - 1, zero, from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=axis)

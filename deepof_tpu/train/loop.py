@@ -65,20 +65,34 @@ class Trainer:
             self.logger.log("info", int(self.state.step),
                             message=f"resumed from step {int(self.state.step)}")
 
-        # Sharded eval requires eval_batch_size % data-axis size == 0; round
-        # down to the nearest multiple rather than erroring mid-training.
+        # Sharded eval requires eval_batch_size % data-axis size == 0; adjust
+        # to the nearest multiple (minimum one sample per shard) rather than
+        # erroring mid-training.
         data_shards = self.mesh.shape["data"]
         eval_bs = max(cfg.train.eval_batch_size // data_shards, 1) * data_shards
         if eval_bs != cfg.train.eval_batch_size:
             self.logger.log(
                 "warn", 0,
                 message=f"eval_batch_size {cfg.train.eval_batch_size} not "
-                        f"divisible by data axis ({data_shards}); using {eval_bs}")
+                        f"divisible by data axis ({data_shards}); adjusted "
+                        f"to {eval_bs}")
             import dataclasses as _dc
 
             cfg = cfg.replace(train=_dc.replace(cfg.train,
                                                 eval_batch_size=eval_bs))
             self.cfg = cfg
+
+        spatial = self.mesh.shape.get("spatial", 1)
+        if spatial > 1:
+            from ..parallel.spatial import MIN_H_PER_SPATIAL_SHARD
+
+            h = (cfg.data.crop_size or cfg.data.image_size)[0]
+            if h < MIN_H_PER_SPATIAL_SHARD * spatial:
+                self.logger.log(
+                    "warn", 0,
+                    message=f"spatial CP inactive: H={h} < "
+                            f"{MIN_H_PER_SPATIAL_SHARD}*spatial({spatial}); "
+                            "those devices only replicate work")
 
         smooth_border = cfg.model in ("st_single", "st_baseline")
         self.train_step = make_train_step(self.model, cfg, self.dataset.mean,

@@ -33,6 +33,7 @@ from ..losses.pyramid import (
     pyramid_loss_multi,
 )
 from ..parallel.mesh import batch_sharding, replicated_sharding
+from ..parallel.spatial import constrain_batch, mesh_context
 from .state import TrainState
 
 Mean = tuple[float, float, float]
@@ -57,6 +58,11 @@ def model_losses(
     """Forward + objective. Returns (total_loss, aux dict with per-scale
     loss dicts, finest flow, reconstruction, and optional action logits)."""
     rngs = {"dropout": dropout_rng} if (train and dropout_rng is not None) else None
+    # Spatial context parallelism: shard H over the "spatial" mesh axis (if
+    # populated) so GSPMD partitions the convs with compiler-inserted halo
+    # exchanges (SURVEY.md §5.7). Reads the mesh from the enclosing
+    # `mesh_context` set by the step builders.
+    batch = constrain_batch(batch)
 
     def fwd(x, **kw):
         out = model.apply({"params": params}, x.astype(compute_dtype),
@@ -121,10 +127,12 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
         rng, dropout_rng = jax.random.split(state.rng)
 
         def loss_fn(params):
-            total, aux = model_losses(
-                model, params, batch, mean, cfg.loss, train=True,
-                dropout_rng=dropout_rng, smooth_border_mask=smooth_border_mask,
-                compute_dtype=compute_dtype)
+            with mesh_context(mesh):
+                total, aux = model_losses(
+                    model, params, batch, mean, cfg.loss, train=True,
+                    dropout_rng=dropout_rng,
+                    smooth_border_mask=smooth_border_mask,
+                    compute_dtype=compute_dtype)
             return total, aux
 
         (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
@@ -156,9 +164,10 @@ def make_eval_fn(model, cfg: ExperimentConfig, mean: Mean, mesh=None,
     (SURVEY.md §3.2)."""
 
     def fwd(params, batch):
-        total, aux = model_losses(
-            model, params, batch, mean, cfg.loss, train=False,
-            smooth_border_mask=smooth_border_mask)
+        with mesh_context(mesh):
+            total, aux = model_losses(
+                model, params, batch, mean, cfg.loss, train=False,
+                smooth_border_mask=smooth_border_mask)
         out = {"total": total}
         for key in ("flow", "recon", "logits"):
             if key in aux:

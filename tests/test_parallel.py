@@ -1,0 +1,115 @@
+"""Spatial CP / temporal pair parallelism tests on the virtual 8-device
+CPU mesh (SURVEY.md §4: multi-node behavior without a real cluster)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepof_tpu.core.config import (
+    DataConfig,
+    ExperimentConfig,
+    LossConfig,
+    MeshConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from deepof_tpu.data import SyntheticData
+from deepof_tpu.models.registry import build_model
+from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+from deepof_tpu.parallel.spatial import halo_exchange
+from deepof_tpu.train.state import create_train_state, make_optimizer
+from deepof_tpu.train.step import make_train_step
+
+H, W = 32, 64
+# Spatial CP only activates at high resolution (H >= 128 * spatial shards,
+# so every pyramid level keeps >= 2 rows per shard — parallel/spatial.py).
+H_CP = 256
+
+
+def _cfg(mesh_cfg: MeshConfig, height: int = H, batch: int = 8,
+         **data_kw) -> ExperimentConfig:
+    data = dict(dataset="synthetic", image_size=(height, W),
+                gt_size=(height, W), batch_size=batch)
+    data.update(data_kw)
+    return ExperimentConfig(
+        model="flownet_s",
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=1e-4),
+        data=DataConfig(**data),
+        mesh=mesh_cfg,
+        train=TrainConfig(seed=0),
+    )
+
+
+def _run_one_step(mesh_cfg: MeshConfig, time_step: int = 2,
+                  expect_constraint: str | None = None, height: int = H,
+                  batch: int = 8):
+    cfg = _cfg(mesh_cfg, height=height, batch=batch, time_step=time_step)
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data)
+    t = cfg.data.time_step
+    model = build_model("flownet_s", flow_channels=2 * (t - 1))
+    tx = make_optimizer(cfg.optim, lambda s: 1e-4)
+    state = create_train_state(model, jnp.zeros((batch, height, W, 3 * t)),
+                               tx, seed=0)
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    batch = jax.device_put(ds.sample_train(batch, iteration=0),
+                           batch_sharding(mesh))
+    if expect_constraint is not None:
+        # positive proof the parallelism is active, not a silent no-op:
+        # the lowered module must carry sharding constraints on the axis
+        txt = step.lower(state, batch).as_text()
+        hits = [l for l in txt.splitlines()
+                if "sharding" in l and f'"{expect_constraint}"' in l
+                and "sdy.mesh" not in l]
+        assert hits, f"no sharding constraint on axis {expect_constraint!r}"
+    new_state, metrics = step(state, batch)
+    return float(metrics["total"]), float(metrics["grad_norm"])
+
+
+def test_spatial_cp_matches_data_parallel():
+    """H sharded over 2 spatial shards == pure data parallel: same loss and
+    same global gradient norm (up to fp reduction order; per-param
+    comparison after Adam is meaningless — the first-step update is
+    ~lr*sign(g), which amplifies fp noise on near-zero grads)."""
+    loss_dp, gn_dp = _run_one_step(MeshConfig(), height=H_CP)
+    loss_sp, gn_sp = _run_one_step(MeshConfig(spatial=2),
+                                   expect_constraint="spatial",
+                                   height=H_CP)
+    assert np.isclose(loss_dp, loss_sp, rtol=1e-5)
+    assert np.isclose(gn_dp, gn_sp, rtol=1e-4)
+
+
+def test_time_axis_pair_parallel_volume():
+    """Sintel-style T-frame volume step with the folded pair axis sharded
+    over the "time" mesh axis matches the unsharded result."""
+    loss_t1, _ = _run_one_step(MeshConfig(), time_step=3)
+    loss_t2, _ = _run_one_step(MeshConfig(time=2), time_step=3,
+                               expect_constraint="time")
+    assert np.isfinite(loss_t2)
+    assert np.isclose(loss_t1, loss_t2, rtol=1e-5)
+
+
+def test_halo_exchange_ring():
+    mesh = build_mesh(MeshConfig(spatial=4, data=2))
+    x = np.arange(8 * 16 * 4, dtype=np.float32).reshape(8, 16, 4)
+
+    fn = shard_map(
+        lambda blk: halo_exchange(blk, halo=2, axis_name="spatial", axis=1),
+        mesh=mesh,
+        in_specs=P(("data",), "spatial"),
+        out_specs=P(("data",), "spatial"),
+    )
+    out = np.asarray(fn(jnp.asarray(x)))  # (8, 16+2*4*2? no: per-shard +4) ->
+    # out global H = 16 + 4 shards * 2*2 halo rows... shard_map concatenates
+    # per-shard (4+4) rows -> global (8, 32, 4)
+    assert out.shape == (8, 32, 4)
+    # shard 1 (global out rows 8..16): halo-from-prev = x rows 2..4,
+    # body = x rows 4..8, halo-from-next = x rows 8..10
+    np.testing.assert_array_equal(out[:, 8:10], x[:, 2:4])
+    np.testing.assert_array_equal(out[:, 10:14], x[:, 4:8])
+    np.testing.assert_array_equal(out[:, 14:16], x[:, 8:10])
+    # edge shards: zero halos at the outer borders
+    assert (out[:, 0:2] == 0).all() and (out[:, -2:] == 0).all()
