@@ -136,6 +136,7 @@ class FlyingChairsData:
         self.num_train, self.num_val = len(self.train_ids), len(self.val_ids)
         self._root = root
         self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+        self._flo_hw: tuple[int, int] | None = None  # native path probe
 
     def _load(self, sid: str, with_flow: bool) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
         p = os.path.join(self._root, sid)
@@ -145,12 +146,40 @@ class FlyingChairsData:
         return src, tgt, flow
 
     def _batch(self, sids: list[str]) -> dict:
+        native = self._native_batch(sids)
+        if native is not None:
+            return native
         srcs, tgts, flows = zip(*(self._load(s, True) for s in sids))
         return {
             "source": np.stack(srcs).astype(np.float32),
             "target": np.stack(tgts).astype(np.float32),
             "flow": np.stack(flows).astype(np.float32),
         }
+
+    def _native_batch(self, sids: list[str]) -> dict | None:
+        """Whole-batch parallel decode through the C++ IO library (thread
+        pool outside the GIL; deepof_tpu/native).
+
+        Only used in streaming mode (`cache_decoded=False` — the right
+        setting when the dataset exceeds the decoded-image cache, e.g. the
+        full 22k-pair FlyingChairs set): with the cache enabled, warm RAM
+        hits beat a fresh parallel decode, so the cv2+cache path wins.
+        Falls back to that path when the library is unavailable.
+        """
+        from .. import native
+
+        if self.cfg.cache_decoded or not native.available():
+            return None
+        paths = [os.path.join(self._root, s) for s in sids]
+        if self._flo_hw is None:
+            self._flo_hw = native.flo_dims(paths[0] + "_flow.flo")
+        imgs = native.decode_ppm_batch(
+            [p + sfx for sfx in ("_img1.ppm", "_img2.ppm") for p in paths],
+            self.cfg.image_size)
+        flows = native.read_flo_batch([p + "_flow.flo" for p in paths],
+                                      self._flo_hw)
+        n = len(paths)
+        return {"source": imgs[:n], "target": imgs[n:], "flow": flows}
 
     def sample_train(self, batch_size, iteration=None, rng=None):
         if iteration is not None:  # sequential, gen-2

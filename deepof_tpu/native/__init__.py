@@ -1,0 +1,127 @@
+"""ctypes bindings for the native IO library (io_native.cc).
+
+The shared library is built lazily with g++ on first use and cached next
+to the source; everything degrades gracefully to the Python/cv2 path when
+a toolchain is unavailable (`available()` returns False). No pybind11 —
+plain C ABI + ctypes.
+
+Thread-safety: the C++ side uses its own persistent thread pool and
+touches no Python state, so batch calls release the GIL for their whole
+duration (ctypes releases it around foreign calls) — decode overlaps
+cleanly with the training step under the Prefetcher.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "io_native.cc")
+_LIB_PATH = os.path.join(_HERE, "libdeepof_io.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=180)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            if not _build():
+                _failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _failed = True
+            return None
+        c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+        f32_p = ctypes.POINTER(ctypes.c_float)
+        i32_p = ctypes.POINTER(ctypes.c_int)
+        lib.deepof_decode_ppm.argtypes = [ctypes.c_char_p, f32_p,
+                                          ctypes.c_int, ctypes.c_int]
+        lib.deepof_ppm_dims.argtypes = [ctypes.c_char_p, i32_p, i32_p]
+        lib.deepof_decode_ppm_batch.argtypes = [c_char_pp, ctypes.c_int,
+                                                f32_p, ctypes.c_int,
+                                                ctypes.c_int]
+        lib.deepof_flo_dims.argtypes = [ctypes.c_char_p, i32_p, i32_p]
+        lib.deepof_read_flo.argtypes = [ctypes.c_char_p, f32_p, ctypes.c_int,
+                                        ctypes.c_int]
+        lib.deepof_read_flo_batch.argtypes = [c_char_pp, ctypes.c_int, f32_p,
+                                              ctypes.c_int, ctypes.c_int]
+        for fn in ("deepof_decode_ppm", "deepof_ppm_dims",
+                   "deepof_decode_ppm_batch", "deepof_flo_dims",
+                   "deepof_read_flo", "deepof_read_flo_batch"):
+            getattr(lib, fn).restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _paths_array(paths: list[str]):
+    arr = (ctypes.c_char_p * len(paths))()
+    arr[:] = [p.encode() for p in paths]
+    return arr
+
+
+def decode_ppm_batch(paths: list[str], size: tuple[int, int]) -> np.ndarray:
+    """Parallel-decode PPMs to (N, H, W, 3) float32 BGR resized to `size`."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    h, w = size
+    out = np.empty((len(paths), h, w, 3), np.float32)
+    failures = lib.deepof_decode_ppm_batch(
+        _paths_array(paths), len(paths),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), h, w)
+    if failures:
+        raise IOError(f"native PPM decode failed for {failures} file(s) "
+                      f"in batch of {len(paths)}")
+    return out
+
+
+def read_flo_batch(paths: list[str], size: tuple[int, int]) -> np.ndarray:
+    """Parallel-read .flo files (all of shape `size`) to (N, H, W, 2)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    h, w = size
+    out = np.empty((len(paths), h, w, 2), np.float32)
+    failures = lib.deepof_read_flo_batch(
+        _paths_array(paths), len(paths),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), h, w)
+    if failures:
+        raise IOError(f"native .flo read failed for {failures} file(s)")
+    return out
+
+
+def flo_dims(path: str) -> tuple[int, int]:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native IO library unavailable")
+    h, w = ctypes.c_int(), ctypes.c_int()
+    if lib.deepof_flo_dims(path.encode(), ctypes.byref(h), ctypes.byref(w)):
+        raise IOError(f"bad .flo file: {path}")
+    return h.value, w.value

@@ -1,0 +1,282 @@
+// Native host-side IO for the data pipeline: PPM (P6) decode, Middlebury
+// .flo parse, bilinear resize, and a persistent thread pool for batch
+// assembly.
+//
+// The reference's loaders decode every image synchronously in Python per
+// training step (`sintelLoader.py:85`, SURVEY.md §7.3.4) — at TPU step
+// times the host becomes the bottleneck. This library decodes a whole
+// batch in parallel outside the GIL; Python binds via ctypes
+// (deepof_tpu/native/__init__.py), no pybind11 dependency.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -std=c++17 -pthread
+//        io_native.cc -o libdeepof_io.so
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- thread pool
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> job;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            cv_.wait(lk, [this] { return stop_ || !jobs_.empty(); });
+            if (stop_ && jobs_.empty()) return;
+            job = std::move(jobs_.front());
+            jobs_.pop();
+          }
+          job();
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void submit(std::function<void()> job) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      jobs_.push(std::move(job));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::queue<std::function<void()>> jobs_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stop_ = false;
+};
+
+ThreadPool* pool() {
+  static ThreadPool p(std::max(2u, std::thread::hardware_concurrency() / 2));
+  return &p;
+}
+
+// A simple countdown latch so one batch call can await all its jobs.
+struct Latch {
+  explicit Latch(int n) : remaining(n) {}
+  void done() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (--remaining == 0) cv.notify_all();
+  }
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [this] { return remaining == 0; });
+  }
+  int remaining;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+// ------------------------------------------------------------------ PPM (P6)
+bool read_ppm_dims(FILE* f, int* w, int* h) {
+  char magic[3] = {0};
+  if (fscanf(f, "%2s", magic) != 1 || strcmp(magic, "P6") != 0) return false;
+  int vals[3], got = 0;
+  while (got < 3) {
+    int ch = fgetc(f);
+    if (ch == EOF) return false;
+    if (ch == '#') {  // comment to end of line
+      while (ch != '\n' && ch != EOF) ch = fgetc(f);
+      continue;
+    }
+    if (isspace(ch)) continue;
+    ungetc(ch, f);
+    if (fscanf(f, "%d", &vals[got]) != 1) return false;
+    ++got;
+  }
+  fgetc(f);  // single whitespace before binary data
+  if (vals[2] != 255) return false;
+  // range-check: reject absurd/negative dims before any allocation (a
+  // corrupt header must fail the call, not throw on a pool thread)
+  constexpr int kMaxDim = 1 << 16;
+  if (vals[0] <= 0 || vals[1] <= 0 || vals[0] > kMaxDim || vals[1] > kMaxDim)
+    return false;
+  *w = vals[0];
+  *h = vals[1];
+  return true;
+}
+
+// decode one P6 file into interleaved uint8 RGB (native size)
+bool decode_ppm_file(const char* path, std::vector<uint8_t>* buf, int* w,
+                     int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  if (!read_ppm_dims(f, w, h)) {
+    fclose(f);
+    return false;
+  }
+  size_t n = static_cast<size_t>(*w) * (*h) * 3;
+  buf->resize(n);
+  bool ok = fread(buf->data(), 1, n, f) == n;
+  fclose(f);
+  return ok;
+}
+
+// -------------------------------------------------------- bilinear resize
+// uint8 RGB (sh, sw) -> float32 (dh, dw), channel order swapped to BGR to
+// match the reference's cv2 pipeline (`flyingChairsLoader.py:71-79`).
+void resize_bilinear_bgr(const uint8_t* src, int sh, int sw, float* dst,
+                         int dh, int dw) {
+  const float ys = static_cast<float>(sh) / dh;
+  const float xs = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    // cv2-style half-pixel centers
+    float fy = (y + 0.5f) * ys - 0.5f;
+    int y0 = static_cast<int>(fy > 0 ? fy : 0);
+    if (y0 > sh - 1) y0 = sh - 1;
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * xs - 0.5f;
+      int x0 = static_cast<int>(fx > 0 ? fx : 0);
+      if (x0 > sw - 1) x0 = sw - 1;
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      const uint8_t* a = src + (static_cast<size_t>(y0) * sw + x0) * 3;
+      const uint8_t* b = src + (static_cast<size_t>(y0) * sw + x1) * 3;
+      const uint8_t* c = src + (static_cast<size_t>(y1) * sw + x0) * 3;
+      const uint8_t* d = src + (static_cast<size_t>(y1) * sw + x1) * 3;
+      float* out = dst + (static_cast<size_t>(y) * dw + x) * 3;
+      for (int ch = 0; ch < 3; ++ch) {
+        float top = a[ch] + wx * (b[ch] - a[ch]);
+        float bot = c[ch] + wx * (d[ch] - c[ch]);
+        out[2 - ch] = top + wy * (bot - top);  // RGB -> BGR
+      }
+    }
+  }
+}
+
+constexpr float kFloMagic = 202021.25f;
+
+}  // namespace
+
+extern "C" {
+
+// Decode one PPM to float32 BGR resized to (dh, dw). Returns 0 on success.
+int deepof_decode_ppm(const char* path, float* out, int dh, int dw) {
+  std::vector<uint8_t> buf;
+  int w, h;
+  if (!decode_ppm_file(path, &buf, &w, &h)) return 1;
+  resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
+  return 0;
+}
+
+// Probe a PPM's native dims.
+int deepof_ppm_dims(const char* path, int* h, int* w) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 1;
+  bool ok = read_ppm_dims(f, w, h);
+  fclose(f);
+  return ok ? 0 : 1;
+}
+
+// Decode a batch of PPMs in parallel into (n, dh, dw, 3) float32 BGR.
+// paths: array of n C strings. Returns number of failures.
+int deepof_decode_ppm_batch(const char** paths, int n, float* out, int dh,
+                            int dw) {
+  Latch latch(n);
+  std::atomic<int> failures{0};
+  const size_t stride = static_cast<size_t>(dh) * dw * 3;
+  for (int i = 0; i < n; ++i) {
+    const char* p = paths[i];
+    float* dst = out + stride * i;
+    pool()->submit([p, dst, dh, dw, &latch, &failures] {
+      try {
+        if (deepof_decode_ppm(p, dst, dh, dw) != 0) failures++;
+      } catch (...) {  // never let an exception escape a pool thread
+        failures++;
+      }
+      latch.done();
+    });
+  }
+  latch.wait();
+  return failures.load();
+}
+
+// Middlebury .flo: magic float 202021.25, int32 w, int32 h, then
+// h*w*2 little-endian float32 (u, v interleaved). Returns 0 on success.
+int deepof_flo_dims(const char* path, int* h, int* w) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 1;
+  float magic;
+  int32_t ww, hh;
+  bool ok = fread(&magic, 4, 1, f) == 1 && magic == kFloMagic &&
+            fread(&ww, 4, 1, f) == 1 && fread(&hh, 4, 1, f) == 1;
+  fclose(f);
+  if (!ok) return 1;
+  *w = ww;
+  *h = hh;
+  return 0;
+}
+
+int deepof_read_flo(const char* path, float* out, int h, int w) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 1;
+  // validate the file's own header against the expected dims — the batch
+  // API probes dims once from the first file; a mixed-resolution file must
+  // fail loudly, not fread with the wrong row stride
+  float magic;
+  int32_t ww, hh;
+  if (fread(&magic, 4, 1, f) != 1 || magic != kFloMagic ||
+      fread(&ww, 4, 1, f) != 1 || fread(&hh, 4, 1, f) != 1 || ww != w ||
+      hh != h) {
+    fclose(f);
+    return 1;
+  }
+  size_t n = static_cast<size_t>(h) * w * 2;
+  bool ok = fread(out, 4, n, f) == n;
+  fclose(f);
+  return ok ? 0 : 1;
+}
+
+// Parallel batch .flo read into (n, h, w, 2) float32.
+int deepof_read_flo_batch(const char** paths, int n, float* out, int h,
+                          int w) {
+  Latch latch(n);
+  std::atomic<int> failures{0};
+  const size_t stride = static_cast<size_t>(h) * w * 2;
+  for (int i = 0; i < n; ++i) {
+    const char* p = paths[i];
+    float* dst = out + stride * i;
+    pool()->submit([p, dst, h, w, &latch, &failures] {
+      try {
+        if (deepof_read_flo(p, dst, h, w) != 0) failures++;
+      } catch (...) {
+        failures++;
+      }
+      latch.done();
+    });
+  }
+  latch.wait();
+  return failures.load();
+}
+
+}  // extern "C"
