@@ -1,0 +1,128 @@
+"""Offline training-log analysis — the reference's `analyze_test_loss.py`
+(grep stdout for `***Test:` lines + matplotlib, `analyze_test_loss.py:12-24`)
+rebuilt over the structured JSONL metrics log.
+
+Prints per-kind summaries (train loss trajectory, eval AEE/AAE curve,
+throughput) and, when matplotlib is importable, writes loss/AEE curves as
+PNGs next to the log.
+
+Deliberately imports NOTHING from the training stack (no jax): analyzing a
+log must not initialize an accelerator backend — especially not against a
+TPU a live trainer already holds. Lives at the package top level so the
+import chain stays `json`/`os`-only.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from collections import defaultdict
+
+
+def _finite(records: list[dict], key: str) -> list[dict]:
+    return [r for r in records
+            if isinstance(r.get(key), (int, float))
+            and math.isfinite(r[key])]
+
+
+def load_records(log_dir: str, filename: str = "metrics.jsonl") -> list[dict]:
+    path = os.path.join(log_dir, filename)
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # tolerate torn writes from a killed run
+    return records
+
+
+def summarize(records: list[dict]) -> dict:
+    by_kind: dict[str, list[dict]] = defaultdict(list)
+    for r in records:
+        by_kind[r.get("kind", "?")].append(r)
+
+    out: dict = {"counts": {k: len(v) for k, v in by_kind.items()}}
+
+    raw_train = [r for r in by_kind.get("train", []) if "loss" in r]
+    train = _finite(raw_train, "loss")
+    if len(train) != len(raw_train):  # NaN losses break min() and JSON
+        out["non_finite_train_records"] = len(raw_train) - len(train)
+    if train:
+        first, last = train[0], train[-1]
+        best = min(train, key=lambda r: r["loss"])
+        out["train"] = {
+            "steps": last["step"],
+            "first_loss": first["loss"],
+            "last_loss": last["loss"],
+            "best_loss": best["loss"],
+            "best_step": best["step"],
+            "last_lr": last.get("lr"),
+            "items_per_sec_per_chip": last.get("items_per_sec_per_chip"),
+        }
+
+    evals = _finite(by_kind.get("eval", []), "aee")
+    if evals:
+        best = min(evals, key=lambda r: r["aee"])
+        out["eval"] = {
+            "evals": len(evals),
+            "last_aee": evals[-1]["aee"],
+            "best_aee": best["aee"],
+            "best_step": best["step"],
+            "last_aae": evals[-1].get("aae"),
+        }
+    accs = _finite(by_kind.get("eval", []), "accuracy")
+    if accs:
+        best = max(accs, key=lambda r: r["accuracy"])
+        out["accuracy"] = {"last": accs[-1]["accuracy"],
+                          "best": best["accuracy"], "best_step": best["step"]}
+
+    warns = by_kind.get("warn", [])
+    if warns:
+        out["warnings"] = [r.get("message", "") for r in warns[-5:]]
+    return out
+
+
+def plot_curves(records: list[dict], out_dir: str) -> list[str]:
+    """Write loss/AEE PNGs when matplotlib is available; returns paths."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:  # noqa: BLE001 - plotting is strictly optional
+        return []
+
+    written = []
+    series = {
+        "train_loss": [(r["step"], r["loss"]) for r in records
+                       if r.get("kind") == "train" and "loss" in r],
+        "eval_aee": [(r["step"], r["aee"]) for r in records
+                     if r.get("kind") == "eval" and "aee" in r],
+    }
+    for name, pts in series.items():
+        if len(pts) < 2:
+            continue
+        xs, ys = zip(*pts)
+        fig, ax = plt.subplots(figsize=(8, 4))
+        ax.plot(xs, ys)
+        ax.set_xlabel("step")
+        ax.set_ylabel(name)
+        ax.grid(True, alpha=0.3)
+        path = os.path.join(out_dir, f"{name}.png")
+        fig.savefig(path, dpi=100, bbox_inches="tight")
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+def analyze(log_dir: str, plot: bool = True) -> dict:
+    records = load_records(log_dir)
+    summary = summarize(records)
+    if plot:
+        summary["plots"] = plot_curves(records, log_dir)
+    return summary

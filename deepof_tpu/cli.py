@@ -101,7 +101,23 @@ def main(argv=None) -> int:
     p_bench.add_argument("--batch", type=int, default=16)
     p_bench.add_argument("--steps", type=int, default=20)
 
+    p_an = sub.add_parser("analyze", help="summarize a run's metrics log")
+    p_an.add_argument("--log-dir", required=True)
+    p_an.add_argument("--no-plot", action="store_true")
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "analyze":
+        # deliberately light import: must not pull in jax / the train stack
+        from .analyze import analyze
+
+        try:
+            summary = analyze(args.log_dir, plot=not args.no_plot)
+        except FileNotFoundError:
+            raise SystemExit(f"no metrics.jsonl under {args.log_dir!r} — "
+                             "is this a run's --log-dir?")
+        print(json.dumps(summary, indent=2))
+        return 0
 
     if args.cmd == "bench":
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

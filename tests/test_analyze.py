@@ -1,0 +1,72 @@
+"""Log-analysis tool (the reference's `analyze_test_loss.py` counterpart)."""
+
+import json
+
+from deepof_tpu.analyze import analyze, load_records, summarize
+
+
+def _write_log(tmp_path, records):
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"torn\n')  # torn write from a killed run must be tolerated
+
+
+def test_summarize_and_load(tmp_path):
+    _write_log(tmp_path, [
+        {"kind": "info", "step": 0, "message": "params: 1"},
+        {"kind": "train", "step": 100, "loss": 5.0, "lr": 1e-4,
+         "items_per_sec_per_chip": 10.0},
+        {"kind": "train", "step": 200, "loss": 3.0, "lr": 1e-4},
+        {"kind": "eval", "step": 200, "aee": 4.5, "aae": 1.2},
+        {"kind": "eval", "step": 400, "aee": 3.5, "aae": 1.0},
+        {"kind": "train", "step": 400, "loss": 4.0, "lr": 5e-5},
+        {"kind": "warn", "step": 401, "message": "NaN; rolled back"},
+    ])
+    recs = load_records(str(tmp_path))
+    assert len(recs) == 7  # torn line dropped
+    s = summarize(recs)
+    assert s["train"]["best_loss"] == 3.0 and s["train"]["best_step"] == 200
+    assert s["train"]["last_loss"] == 4.0
+    assert s["eval"]["best_aee"] == 3.5 and s["eval"]["evals"] == 2
+    assert s["warnings"] == ["NaN; rolled back"]
+
+    out = analyze(str(tmp_path), plot=True)
+    assert out["counts"]["train"] == 3
+    # plots written only if matplotlib exists; either way the key is present
+    assert isinstance(out.get("plots", []), list)
+
+
+def test_accuracy_summary(tmp_path):
+    _write_log(tmp_path, [
+        {"kind": "eval", "step": 10, "accuracy": 0.4},
+        {"kind": "eval", "step": 20, "accuracy": 0.6},
+    ])
+    s = summarize(load_records(str(tmp_path)))
+    assert s["accuracy"]["best"] == 0.6
+
+
+def test_nan_records_excluded(tmp_path):
+    _write_log(tmp_path, [
+        {"kind": "train", "step": 1, "loss": float("nan")},
+        {"kind": "train", "step": 2, "loss": 2.5},
+    ])
+    s = summarize(load_records(str(tmp_path)))
+    assert s["train"]["best_loss"] == 2.5  # NaN must not win min()
+    assert s["non_finite_train_records"] == 1
+    # the summary must stay strict-JSON serializable
+    json.dumps(s, allow_nan=False)
+
+
+def test_analyze_is_jax_free():
+    """The tool must be usable next to a live trainer: importing it cannot
+    initialize an accelerator backend."""
+    import subprocess
+    import sys
+
+    code = ("import sys; import deepof_tpu.analyze; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)")
+    res = subprocess.run([sys.executable, "-c", code], timeout=60,
+                         env={"PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo"},
+                         capture_output=True)
+    assert res.returncode == 0, res.stderr.decode()[-500:]
