@@ -88,6 +88,11 @@ def main(argv=None) -> int:
     p_train.add_argument("--synthetic", action="store_true",
                          help="swap in the synthetic dataset at small shapes "
                               "(smoke tests; no data on disk needed)")
+    p_train.add_argument("--multihost", action="store_true",
+                         help="call jax.distributed.initialize() so the mesh "
+                              "spans hosts (data axis over DCN). batch_size "
+                              "is GLOBAL; hosts currently load the full "
+                              "batch redundantly (single-writer ckpt/logs)")
 
     p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
     _add_common(p_eval)
@@ -140,6 +145,11 @@ def main(argv=None) -> int:
     if args.cmd == "config":
         print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
         return 0
+
+    if getattr(args, "multihost", False):
+        import jax
+
+        jax.distributed.initialize()  # coordinator/process env-configured
 
     from .train.loop import Trainer
 

@@ -104,6 +104,10 @@ class TrainConfig:
     nan_guard: bool = True
     dump_visuals: bool = False
     compute_dtype: str = "float32"  # float32 | bfloat16
+    # jax.checkpoint the model forward: recompute activations in backward
+    # instead of storing them — trades FLOPs for HBM (for high-res /
+    # long-T configs that would not otherwise fit).
+    remat: bool = False
 
 
 @dataclass(frozen=True)

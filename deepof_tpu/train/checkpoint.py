@@ -46,11 +46,16 @@ class CheckpointManager:
     def save(self, state: TrainState) -> str:
         step = int(jax.device_get(state.step))
         path = self._path(step)
-        if os.path.exists(path):
+        # Multi-host: orbax coordinates the distributed write itself, but
+        # directory surgery (clobber + prune) must be single-writer or one
+        # host can rmtree a directory another host's writer is mid-write to.
+        primary = jax.process_index() == 0
+        if primary and os.path.exists(path):
             shutil.rmtree(path)
         self._ckpt.save(path, state)
-        for old in self.all_steps()[: -self.keep]:
-            shutil.rmtree(self._path(old), ignore_errors=True)
+        if primary:
+            for old in self.all_steps()[: -self.keep]:
+                shutil.rmtree(self._path(old), ignore_errors=True)
         return path
 
     def restore(self, template: TrainState, step: int | None = None) -> TrainState | None:

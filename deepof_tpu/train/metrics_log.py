@@ -26,12 +26,19 @@ def _scalarize(v):
 class MetricsLogger:
     def __init__(self, log_dir: str, filename: str = "metrics.jsonl",
                  echo: bool = True):
-        os.makedirs(log_dir, exist_ok=True)
+        # Multi-host: one writer — every process computes identical metrics
+        # (state is replicated), so non-primary hosts would only interleave
+        # duplicate lines into a shared log_dir.
+        self._primary = jax.process_index() == 0
         self.path = os.path.join(log_dir, filename)
-        self._f = open(self.path, "a", buffering=1)
-        self.echo = echo
+        if self._primary:
+            os.makedirs(log_dir, exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+        self.echo = echo and self._primary
 
     def log(self, kind: str, step: int, **metrics) -> None:
+        if not self._primary:
+            return
         rec = {"kind": kind, "step": int(step), "time": time.time()}
         rec.update({k: _scalarize(v) for k, v in metrics.items()})
         self._f.write(json.dumps(rec) + "\n")
@@ -41,7 +48,8 @@ class MetricsLogger:
             print(brief, flush=True)
 
     def close(self) -> None:
-        self._f.close()
+        if self._primary:
+            self._f.close()
 
 
 class StepTimer:

@@ -54,6 +54,7 @@ def model_losses(
     dropout_rng: jax.Array | None = None,
     smooth_border_mask: bool = False,
     compute_dtype: Any = jnp.float32,
+    remat: bool = False,
 ) -> tuple[jnp.ndarray, dict[str, Any]]:
     """Forward + objective. Returns (total_loss, aux dict with per-scale
     loss dicts, finest flow, reconstruction, and optional action logits)."""
@@ -65,9 +66,15 @@ def model_losses(
     batch = constrain_batch(batch)
 
     def fwd(x, **kw):
-        out = model.apply({"params": params}, x.astype(compute_dtype),
-                          rngs=rngs, **kw)
-        return jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), out)
+        def inner(xx):
+            out = model.apply({"params": params}, xx.astype(compute_dtype),
+                              rngs=rngs, **kw)
+            return jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), out)
+
+        # rematerialize the encoder-decoder in backward instead of storing
+        # its activations (TrainConfig.remat; params are closure-captured,
+        # which jax.checkpoint differentiates through)
+        return jax.checkpoint(inner)(x) if remat else inner(x)
 
     aux: dict[str, Any] = {}
 
@@ -132,7 +139,7 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
                     model, params, batch, mean, cfg.loss, train=True,
                     dropout_rng=dropout_rng,
                     smooth_border_mask=smooth_border_mask,
-                    compute_dtype=compute_dtype)
+                    compute_dtype=compute_dtype, remat=cfg.train.remat)
             return total, aux
 
         (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)

@@ -128,6 +128,28 @@ def test_checkpoint_roundtrip(tmp_path):
     assert mgr.all_steps() == [9, 10]
 
 
+def test_remat_train_step_matches(tmp_path):
+    """jax.checkpoint'ed forward must give the same loss/grads (it only
+    changes what is stored vs recomputed)."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data)
+    model = build_model("flownet_s")
+    tx = make_optimizer(cfg.optim, lambda s: 1e-4)
+    batch = jax.device_put(ds.sample_train(8, iteration=0), batch_sharding(mesh))
+    results = {}
+    for remat in (False, True):
+        c = cfg.replace(train=dataclasses.replace(cfg.train, remat=remat))
+        state = create_train_state(model, jnp.zeros((8, H, W, 6)), tx, seed=0)
+        step = make_train_step(model, c, ds.mean, mesh)
+        _, metrics = step(state, batch)
+        results[remat] = (float(metrics["total"]), float(metrics["grad_norm"]))
+    assert np.isclose(results[False][0], results[True][0], rtol=1e-6)
+    assert np.isclose(results[False][1], results[True][1], rtol=1e-5)
+
+
 def test_volume_train_step(tmp_path):
     cfg = _cfg(tmp_path, time_step=3)
     mesh = build_mesh(cfg.mesh)
