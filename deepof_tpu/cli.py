@@ -73,6 +73,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data-path", default=None)
     p.add_argument("--log-dir", default=None)
     p.add_argument("--set", action="append", metavar="SECTION.FIELD=VALUE")
+    p.add_argument("--multihost", action="store_true",
+                   help="call jax.distributed.initialize() so the mesh spans "
+                        "hosts (data axis over DCN). batch_size is GLOBAL; "
+                        "hosts currently load the full batch redundantly "
+                        "(single-writer ckpt/logs/visuals)")
 
 
 def main(argv=None) -> int:
@@ -88,11 +93,6 @@ def main(argv=None) -> int:
     p_train.add_argument("--synthetic", action="store_true",
                          help="swap in the synthetic dataset at small shapes "
                               "(smoke tests; no data on disk needed)")
-    p_train.add_argument("--multihost", action="store_true",
-                         help="call jax.distributed.initialize() so the mesh "
-                              "spans hosts (data axis over DCN). batch_size "
-                              "is GLOBAL; hosts currently load the full "
-                              "batch redundantly (single-writer ckpt/logs)")
 
     p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
     _add_common(p_eval)

@@ -115,6 +115,8 @@ class Trainer:
         return batch
 
     def evaluate(self, dump: bool = False) -> dict[str, float]:
+        # visuals are identical on every host (replicated state): one writer
+        dump = dump and jax.process_index() == 0
         dump_dir = (self.cfg.train.log_dir + "/visuals") if dump else None
         if self.cfg.model in ("st_single", "st_baseline", "ucf101_spatial"):
             return evaluate_ucf101(self.eval_fn, self.state.params,
