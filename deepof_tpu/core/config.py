@@ -44,6 +44,10 @@ class LossConfig:
     # vs the raw head output (gen-1 `version1/model/warpflow.py:37,133`).
     smooth_scaled_flow: bool = True
     border_ratio: float = 0.1
+    # Warp implementation: "xla" (fused XLA gather, any level size),
+    # "pallas" (VMEM row-sweep kernel, W <= 128 only), "auto" (pallas for
+    # coarse pyramid levels, XLA for fine — see ops/pallas/warp.py).
+    warp_impl: str = "xla"
 
 
 @dataclass(frozen=True)

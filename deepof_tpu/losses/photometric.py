@@ -104,7 +104,7 @@ def loss_interp(
     """
     b, h, w, c = inputs.shape
     scaled = flow * flow_scale
-    recon = backward_warp(outputs, scaled)
+    recon = backward_warp(outputs, scaled, impl=cfg.warp_impl)
 
     bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)
     diff = 255.0 * (recon - inputs)
@@ -180,7 +180,7 @@ def loss_interp_multi(
     b, h, w, c3t = volume.shape
     t = c3t // 3
     scaled = flows * flow_scale
-    recon = backward_warp_volume(volume, scaled)  # (B,h,w,3*(T-1))
+    recon = backward_warp_volume(volume, scaled, impl=cfg.warp_impl)
 
     bmask = border_mask(h, w, cfg.border_ratio)
     diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])

@@ -17,13 +17,21 @@ nodes):
 the previous frame's coordinates ("reconstructs" in the reference).
 
 TPU note: XLA lowers `jnp.take_along_axis` over the flattened H*W axis to a
-single dynamic-gather; the Pallas fused kernel in `ops/pallas/warp_loss.py`
-goes further and fuses warp + Charbonnier + masked reduction.
+single dynamic-gather, which is the right tool for fine pyramid levels
+(Mosaic cannot express arbitrary-displacement gathers — see
+`ops/pallas/warp.py`). For coarse levels (W <= 128) the Pallas row-sweep
+kernel computes the same warp in one VMEM pass; select it with
+`impl="pallas"` or `impl="auto"`.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+#: levels at least this small on both sides use the Pallas kernel under
+#: impl="auto" (W must fit one 128-lane register; the 2H-1 row sweep is
+#: what bounds the kernel's cost, so very tall-narrow inputs stay on XLA).
+PALLAS_AUTO_MAX_H = 64
 
 
 def _gather_hw(img_flat: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
@@ -31,13 +39,24 @@ def _gather_hw(img_flat: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(img_flat, idx[..., None], axis=1)
 
 
-def backward_warp(image: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
+def backward_warp(image: jnp.ndarray, flow: jnp.ndarray,
+                  impl: str = "xla") -> jnp.ndarray:
     """Warp `image` (B, H, W, C) backward by `flow` (B, H, W, 2).
 
     `flow` must already include any flow_scale factor (the caller applies it,
     as the reference does at `flyingChairsWrapFlow.py:785`).
+
+    impl: "xla" (fused XLA gather, any size), "pallas" (VMEM row-sweep
+    kernel, requires W <= 128), or "auto" (pallas for small levels).
     """
     b, h, w, c = image.shape
+    if impl == "pallas" or (impl == "auto" and w <= 128
+                            and h <= PALLAS_AUTO_MAX_H):
+        from .pallas.warp import backward_warp_pallas
+
+        return backward_warp_pallas(image, flow)
+    elif impl not in ("xla", "auto"):
+        raise ValueError(f"unknown warp impl {impl!r}")
     img_flat = image.reshape(b, h * w, c)
     flow_flat = flow.reshape(b, h * w, 2)
 
@@ -69,7 +88,8 @@ def backward_warp(image: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
     return out.reshape(b, h, w, c)
 
 
-def backward_warp_volume(volume: jnp.ndarray, flows: jnp.ndarray) -> jnp.ndarray:
+def backward_warp_volume(volume: jnp.ndarray, flows: jnp.ndarray,
+                         impl: str = "xla") -> jnp.ndarray:
     """Multi-frame warp (reference `sintelWrapFlow.py:539-577` semantics).
 
     volume: (B, H, W, 3*T) channel-stacked frames; flows: (B, H, W, 2*(T-1)).
@@ -90,5 +110,5 @@ def backward_warp_volume(volume: jnp.ndarray, flows: jnp.ndarray) -> jnp.ndarray
         jnp.moveaxis(frames[..., 1:, :], 3, 1).reshape(b * (t - 1), h, w, 3))
     flw = pair_axis_constraint(
         jnp.moveaxis(pairs, 3, 1).reshape(b * (t - 1), h, w, 2))
-    rec = backward_warp(nxt, flw).reshape(b, t - 1, h, w, 3)
+    rec = backward_warp(nxt, flw, impl=impl).reshape(b, t - 1, h, w, 3)
     return jnp.moveaxis(rec, 1, 3).reshape(b, h, w, 3 * (t - 1))

@@ -1,0 +1,103 @@
+"""Pallas warp kernel vs the jnp/XLA oracle (interpret mode on CPU).
+
+Mirrors the reference's golden-test pattern (`check_loss.py`: numpy
+re-implementation vs the accelerated graph — SURVEY.md §4.2): the
+vectorized jnp `backward_warp` is itself tested against numpy in
+test_warp.py, and serves here as the oracle for the Pallas kernel.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepof_tpu.core.config import LossConfig
+from deepof_tpu.losses.photometric import loss_interp, loss_interp_multi
+from deepof_tpu.ops.warp import backward_warp
+from deepof_tpu.ops.pallas.warp import backward_warp_pallas
+
+
+@pytest.mark.parametrize(
+    "shape,mag",
+    [((2, 5, 7, 3), 3.0),      # level-6 size: flow >> image size (all clip)
+     ((2, 10, 14, 3), 30.0),   # level-5
+     ((1, 40, 56, 3), 80.0),   # level-3
+     ((2, 16, 128, 2), 200.0)],  # full-lane width, huge flow
+)
+def test_pallas_warp_matches_xla(rng, shape, mag):
+    b, h, w, c = shape
+    img = jnp.asarray(rng.rand(b, h, w, c), jnp.float32)
+    flow = jnp.asarray(rng.randn(b, h, w, 2) * mag, jnp.float32)
+    ref = backward_warp(img, flow)
+    out = backward_warp_pallas(img, flow)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_warp_rejects_wide_levels(rng):
+    img = jnp.zeros((1, 8, 256, 3))
+    flow = jnp.zeros((1, 8, 256, 2))
+    with pytest.raises(ValueError, match="W <= 128"):
+        backward_warp_pallas(img, flow)
+
+
+def test_pallas_warp_gradients_match(rng):
+    img = jnp.asarray(rng.rand(2, 10, 14, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(2, 10, 14, 2) * 2.0, jnp.float32)
+
+    def loss_p(i, f):
+        return jnp.sum(backward_warp_pallas(i, f) ** 2)
+
+    def loss_x(i, f):
+        return jnp.sum(backward_warp(i, f) ** 2)
+
+    gip, gfp = jax.grad(loss_p, argnums=(0, 1))(img, flow)
+    gix, gfx = jax.grad(loss_x, argnums=(0, 1))(img, flow)
+    np.testing.assert_allclose(np.asarray(gfp), np.asarray(gfx),
+                               rtol=1e-5, atol=1e-5)
+    # image cotangent (bilinear scatter) must match too — impl switching
+    # may not change gradient semantics
+    np.testing.assert_allclose(np.asarray(gip), np.asarray(gix),
+                               rtol=1e-5, atol=1e-5)
+    assert float(jnp.abs(gip).max()) > 0.0
+
+
+def test_loss_interp_pallas_impl_matches(rng):
+    cfg_x = LossConfig()
+    cfg_p = LossConfig(warp_impl="pallas")
+    flow = jnp.asarray(rng.randn(2, 20, 28, 2), jnp.float32)
+    prev = jnp.asarray(rng.rand(2, 20, 28, 3), jnp.float32)
+    nxt = jnp.asarray(rng.rand(2, 20, 28, 3), jnp.float32)
+    lx, rx = loss_interp(flow, prev, nxt, 2.5, cfg_x)
+    lp, rp = loss_interp(flow, prev, nxt, 2.5, cfg_p)
+    np.testing.assert_allclose(np.asarray(rp), np.asarray(rx),
+                               rtol=1e-5, atol=1e-5)
+    for k in lx:
+        np.testing.assert_allclose(float(lp[k]), float(lx[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_loss_interp_multi_pallas_impl_matches(rng):
+    t = 4
+    cfg_x = LossConfig()
+    cfg_p = LossConfig(warp_impl="pallas")
+    flows = jnp.asarray(rng.randn(2, 10, 14, 2 * (t - 1)), jnp.float32)
+    vol = jnp.asarray(rng.rand(2, 10, 14, 3 * t), jnp.float32)
+    lx, _ = loss_interp_multi(flows, vol, 1.25, cfg_x)
+    lp, _ = loss_interp_multi(flows, vol, 1.25, cfg_p)
+    for k in lx:
+        np.testing.assert_allclose(float(lp[k]), float(lx[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_auto_impl_dispatch(rng):
+    # auto: small level -> pallas path must agree; wide level -> xla path runs
+    img = jnp.asarray(rng.rand(1, 12, 16, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(1, 12, 16, 2), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(backward_warp(img, flow, impl="auto")),
+        np.asarray(backward_warp(img, flow)), rtol=1e-5, atol=1e-5)
+    wide = jnp.asarray(rng.rand(1, 8, 200, 3), jnp.float32)
+    wflow = jnp.zeros((1, 8, 200, 2))
+    out = backward_warp(wide, wflow, impl="auto")  # falls back to xla
+    np.testing.assert_allclose(np.asarray(out), np.asarray(wide), atol=1e-6)
