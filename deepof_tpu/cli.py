@@ -78,6 +78,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "hosts (data axis over DCN). batch_size is GLOBAL; "
                         "hosts currently load the full batch redundantly "
                         "(single-writer ckpt/logs/visuals)")
+    p.add_argument("--synthetic", action="store_true",
+                   help="swap in the synthetic dataset at small shapes "
+                        "(smoke tests; no data on disk needed)")
 
 
 def main(argv=None) -> int:
@@ -90,13 +93,19 @@ def main(argv=None) -> int:
     p_train.add_argument("--max-steps", "--steps", dest="max_steps",
                          type=int, default=None)
     p_train.add_argument("--profile", action="store_true")
-    p_train.add_argument("--synthetic", action="store_true",
-                         help="swap in the synthetic dataset at small shapes "
-                              "(smoke tests; no data on disk needed)")
 
     p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
     _add_common(p_eval)
     p_eval.add_argument("--dump-visuals", action="store_true")
+
+    p_pred = sub.add_parser(
+        "predict", help="run a trained model on image pairs; write .flo + png")
+    _add_common(p_pred)
+    p_pred.add_argument("--pairs", nargs="+", required=True,
+                        metavar="PREV:NEXT",
+                        help="image-path pairs, colon-separated")
+    p_pred.add_argument("--out", required=True, help="output directory")
+    p_pred.add_argument("--no-png", action="store_true")
 
     p_cfg = sub.add_parser("config", help="print the resolved config")
     _add_common(p_cfg)
@@ -150,6 +159,20 @@ def main(argv=None) -> int:
         import jax
 
         jax.distributed.initialize()  # coordinator/process env-configured
+
+    if args.cmd == "predict":
+        from .predict import predict_pairs
+
+        pairs = []
+        for item in args.pairs:
+            if ":" not in item:
+                raise SystemExit(f"bad --pairs {item!r}: use prev.png:next.png")
+            prev, nxt = item.split(":", 1)
+            pairs.append((prev, nxt))
+        written = predict_pairs(cfg, pairs, args.out,
+                                write_png=not args.no_png)
+        print(json.dumps({"written": written}))
+        return 0
 
     from .train.loop import Trainer
 

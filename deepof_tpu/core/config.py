@@ -107,6 +107,12 @@ class TrainConfig:
     eval_batch_size: int = 8
     nan_guard: bool = True
     dump_visuals: bool = False
+    # Path to the public `vgg16_weights.npz`; when set, VGG-trunk models
+    # start from these conv weights with first-layer in-channel duplication
+    # (reference `flyingChairsTrain.py:60-76,142-145`, `ucf101train.py:68-88`
+    # with VGG16Init=True). No auto-download (zero-egress). A restored
+    # checkpoint takes precedence.
+    vgg16_npz: str = ""
     compute_dtype: str = "float32"  # float32 | bfloat16
     # jax.checkpoint the model forward: recompute activations in backward
     # instead of storing them — trades FLOPs for HBM (for high-res /

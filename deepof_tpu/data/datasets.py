@@ -42,6 +42,13 @@ FLYINGCHAIRS_MEAN = (97.533, 99.238, 97.056)  # BGR, flyingChairsLoader.py:28
 SINTEL_MEAN = (70.1433, 83.1915, 92.8827)  # sintelLoader.py:29
 UCF101_MEAN = (104.0, 117.0, 123.0)  # version1/loader/ucf101Loader.py
 
+DATASET_MEANS = {
+    "flyingchairs": FLYINGCHAIRS_MEAN,
+    "sintel": SINTEL_MEAN,
+    "ucf101": UCF101_MEAN,
+    "synthetic": (0.0, 0.0, 0.0),
+}
+
 
 def _imread_bgr(path: str) -> np.ndarray:
     img = cv2.imread(path, cv2.IMREAD_COLOR)  # BGR, matches reference cv2 use

@@ -192,9 +192,11 @@ def load_vgg16_npz(
              for i in range(1, n + 1)]
     for name in names:
         w, bias = data[f"{name}_W"], data[f"{name}_b"]
-        if name == "conv1_1" and duplicate_input and sub[name]["Conv_0"]["kernel"].shape[2] == 2 * w.shape[2]:
+        # ConvELU trunks nest an nn.Conv as "Conv_0"; _VGGReLUTrunk names
+        # nn.Conv layers directly (two_stream.py) — support both.
+        tgt = sub[name].get("Conv_0", sub[name])
+        if name == "conv1_1" and duplicate_input and tgt["kernel"].shape[2] == 2 * w.shape[2]:
             w = np.concatenate([w, w], axis=2)
-        tgt = sub[name]["Conv_0"]
         assert tgt["kernel"].shape == w.shape, (name, tgt["kernel"].shape, w.shape)
         tgt["kernel"] = jnp.asarray(w)
         tgt["bias"] = jnp.asarray(bias)

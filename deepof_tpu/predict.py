@@ -1,0 +1,99 @@
+"""Inference: run a trained flow model on image pairs, write `.flo` + visuals.
+
+The reference has no standalone inference path — flow predictions only exist
+inside the training/eval session loops (`flyingChairsTrain.py:216-296`,
+`version1/testOF.py`). Decoupling the model from the loss graph
+(SURVEY.md §7.1) makes this a plain forward pass: preprocess, apply, take
+the finest pyramid flow, run the eval amplifier/clip/resize protocol, and
+serialize with the (fixed) Middlebury writer — the reference's `writeFlow`
+was dead code (`utils.py:44`, undefined TAG_CHAR).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.config import ExperimentConfig
+from .data.datasets import _imread_bgr, _resize
+from .io.flo import write_flo
+from .losses.pyramid import preprocess
+from .models.registry import build_model
+from .train.evaluate import postprocess_flow
+from .utils.flowviz import flow_to_color
+
+
+def restore_params(cfg: ExperimentConfig):
+    """Latest-checkpoint params from cfg.train.log_dir (Trainer layout)."""
+    from .train.checkpoint import CheckpointManager
+    from .train.schedule import step_decay_schedule
+    from .train.state import create_train_state, make_optimizer
+
+    t = cfg.data.time_step
+    model = build_model(cfg.model, flow_channels=2 * (t - 1))
+    h, w = cfg.data.image_size  # eval-protocol resolution (val is uncropped)
+    tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
+    template = create_train_state(
+        model, jnp.zeros((1, h, w, 3 * t)), tx, seed=0)
+    state = CheckpointManager(cfg.train.log_dir + "/ckpt").restore(template)
+    if state is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {cfg.train.log_dir}/ckpt")
+    return model, state.params
+
+
+def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
+                  out_dir: str, mean=None,
+                  write_png: bool = True) -> list[str]:
+    """Predict flow for (prev, next) image-path pairs; returns written paths.
+
+    The net runs at cfg.data.image_size (the eval resolution — val samples
+    are never cropped); the output is amplified/clipped per the eval
+    protocol (`flyingChairsTrain.py:264-296`), resized to the source image
+    resolution, and — unlike the reference's AEE protocol, which resizes
+    the flow *map* only — the u/v vectors are rescaled by (W_native/W_net,
+    H_native/H_net) so the standalone `.flo` is in native pixel units.
+    """
+    from .data.datasets import DATASET_MEANS
+
+    model, params = restore_params(cfg)
+    mean = mean if mean is not None else DATASET_MEANS.get(
+        cfg.data.dataset, DATASET_MEANS["flyingchairs"])
+    h, w = cfg.data.image_size
+
+    @jax.jit
+    def fwd(params, pair):
+        flows = model.apply({"params": params}, pair)
+        return flows[0] * model.flow_scales[0]
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for idx, (src_path, tgt_path) in enumerate(pairs):
+        src_raw = _imread_bgr(src_path)
+        native_hw = src_raw.shape[:2]
+        src = _resize(src_raw, (h, w)).astype(np.float32)
+        tgt = _resize(_imread_bgr(tgt_path), (h, w)).astype(np.float32)
+        pair = jnp.concatenate(
+            [preprocess(jnp.asarray(src[None]), mean),
+             preprocess(jnp.asarray(tgt[None]), mean)], axis=-1)
+        flow = np.asarray(fwd(params, pair))
+        flow = postprocess_flow(flow, cfg, native_hw)[0, :, :, :2]
+        flow[..., 0] *= native_hw[1] / w  # u: native horizontal px
+        flow[..., 1] *= native_hw[0] / h  # v: native vertical px
+
+        stem = os.path.splitext(os.path.basename(src_path))[0]
+        if len(pairs) > 1:
+            stem = f"{idx:04d}_{stem}"  # basenames may collide across dirs
+        flo_path = os.path.join(out_dir, f"{stem}_flow.flo")
+        write_flo(flo_path, flow)
+        written.append(flo_path)
+        if write_png:
+            import cv2
+
+            png_path = os.path.join(out_dir, f"{stem}_flow.png")
+            cv2.imwrite(png_path, flow_to_color(flow))
+            written.append(png_path)
+    return written

@@ -59,6 +59,21 @@ class Trainer:
 
         self.ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt",
                                       keep=cfg.train.keep_ckpts)
+        # VGG16 pretrained conv-trunk init (`flyingChairsTrain.py:60-76`);
+        # fresh starts only — a checkpoint to resume from takes precedence.
+        _vgg_trunks = {"vgg16": ("encoder",), "st_single": ("encoder",),
+                       "ucf101_spatial": ("encoder",),
+                       "st_baseline": ("spatial",)}
+        if (cfg.train.vgg16_npz and cfg.model in _vgg_trunks
+                and self.ckpt.latest_step() is None):
+            from ..models.common import load_vgg16_npz
+
+            self.state = self.state.replace(params=load_vgg16_npz(
+                self.state.params, cfg.train.vgg16_npz,
+                trunk_path=_vgg_trunks[cfg.model]))
+            self.logger.log("info", 0,
+                            message=f"VGG16 trunk init from {cfg.train.vgg16_npz}")
+
         restored = self.ckpt.restore(self.state)
         if restored is not None:
             self.state = restored
@@ -148,9 +163,22 @@ class Trainer:
             if cfg.train.nan_guard and self.ckpt.latest_step() is None:
                 self.ckpt.save(self.state)  # rollback target before step 1
             self.profiler.maybe_start()
+            first_step = True
             for step in range(start_step, total_steps):
                 batch = prefetch.get()
-                self.state, metrics = self.train_step(self.state, batch)
+                if first_step:  # XLA compile-time report (SURVEY.md §5.1)
+                    import time as _time
+
+                    t0 = _time.perf_counter()
+                    self.state, metrics = self.train_step(self.state, batch)
+                    jax.block_until_ready(metrics["total"])
+                    self.logger.log(
+                        "info", step + 1,
+                        message=f"first step (compile + run): "
+                                f"{_time.perf_counter() - t0:.1f}s")
+                    first_step = False
+                else:
+                    self.state, metrics = self.train_step(self.state, batch)
                 timer.tick()
                 epoch = (step + 1) // self.steps_per_epoch
                 end_of_epoch = (step + 1) % self.steps_per_epoch == 0

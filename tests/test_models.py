@@ -175,3 +175,74 @@ def test_correlation_stride(rng):
     want = correlation_oracle(f1, f2, max_disp=4, stride=2)
     assert got.shape[-1] == 25  # K = max_disp//stride = 2 -> (2K+1)^2
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_load_vgg16_npz(tmp_path, rng):
+    """Pretrained VGG conv import: 13 conv layers in order, first conv
+    tiled x2 along in-channels for the 6-channel pair input
+    (`flyingChairsTrain.py:60-76`)."""
+    from deepof_tpu.models import load_vgg16_npz
+
+    widths = {1: (64, 2), 2: (128, 2), 3: (256, 3), 4: (512, 3), 5: (512, 3)}
+    data = {}
+    cin = 3
+    for b, (cout, n) in widths.items():
+        c = cin
+        for i in range(1, n + 1):
+            data[f"conv{b}_{i}_W"] = rng.randn(3, 3, c, cout).astype(np.float32)
+            data[f"conv{b}_{i}_b"] = rng.randn(cout).astype(np.float32)
+            c = cout
+        cin = cout
+    npz = str(tmp_path / "vgg16_weights.npz")
+    np.savez(npz, **data)
+
+    model = build_model("vgg16")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, H, W, 6)))["params"]
+    loaded = load_vgg16_npz(params, npz)
+
+    first = np.asarray(loaded["encoder"]["conv1_1"]["Conv_0"]["kernel"])
+    np.testing.assert_array_equal(
+        first, np.concatenate([data["conv1_1_W"]] * 2, axis=2))
+    np.testing.assert_array_equal(
+        np.asarray(loaded["encoder"]["conv5_3"]["Conv_0"]["kernel"]),
+        data["conv5_3_W"])
+    np.testing.assert_array_equal(
+        np.asarray(loaded["encoder"]["conv3_2"]["Conv_0"]["bias"]),
+        data["conv3_2_b"])
+    # decoder untouched
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, loaded["decoder"], params["decoder"])
+
+
+def test_load_vgg16_npz_relu_trunk(tmp_path, rng):
+    """_VGGReLUTrunk models (ucf101_spatial, st_baseline spatial stream)
+    name nn.Conv directly (no Conv_0 nesting); 3-channel input -> no
+    first-layer duplication."""
+    from deepof_tpu.models import load_vgg16_npz
+
+    widths = {1: (64, 2), 2: (128, 2), 3: (256, 3), 4: (512, 3), 5: (512, 3)}
+    data = {}
+    cin = 3
+    for b, (cout, n) in widths.items():
+        c = cin
+        for i in range(1, n + 1):
+            data[f"conv{b}_{i}_W"] = rng.randn(3, 3, c, cout).astype(np.float32)
+            data[f"conv{b}_{i}_b"] = rng.randn(cout).astype(np.float32)
+            c = cout
+        cin = cout
+    npz = str(tmp_path / "vgg16_weights.npz")
+    np.savez(npz, **data)
+
+    model = build_model("ucf101_spatial")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, H, W, 3)))["params"]
+    loaded = load_vgg16_npz(params, npz)
+    trunk = loaded["encoder"]["conv1_1"]
+    tgt = trunk.get("Conv_0", trunk)
+    np.testing.assert_array_equal(np.asarray(tgt["kernel"]), data["conv1_1_W"])
+
+    model = build_model("st_baseline")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, H, W, 6)))["params"]
+    loaded = load_vgg16_npz(params, npz, trunk_path=("spatial",))
+    trunk = loaded["spatial"]["conv5_3"]
+    tgt = trunk.get("Conv_0", trunk)
+    np.testing.assert_array_equal(np.asarray(tgt["kernel"]), data["conv5_3_W"])
