@@ -76,7 +76,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() so the mesh spans "
                         "hosts (data axis over DCN). batch_size is GLOBAL; "
-                        "hosts currently load the full batch redundantly "
+                        "each host loads only its shard's rows for training "
+                        "(decorrelated rng streams), val batches load "
+                        "host-identically and eval outputs allgather "
                         "(single-writer ckpt/logs/visuals)")
     p.add_argument("--synthetic", action="store_true",
                    help="swap in the synthetic dataset at small shapes "

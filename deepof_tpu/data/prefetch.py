@@ -42,7 +42,11 @@ class Prefetcher:
             while not self._stop.is_set():
                 batch = self._next()
                 if self._sharding is not None:
-                    batch = jax.device_put(batch, self._sharding)
+                    # multi-process: producer yields this host's local rows
+                    # and the global array is assembled shard-wise
+                    from ..parallel.mesh import put_global
+
+                    batch = put_global(batch, self._sharding)
                 while not self._stop.is_set():
                     try:
                         self._q.put(batch, timeout=0.1)

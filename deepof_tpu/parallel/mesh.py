@@ -56,3 +56,113 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def process_data_coords(mesh: Mesh) -> list[int]:
+    """Sorted "data"-axis coordinates with devices addressable from this
+    process (single-host: all of them)."""
+    local = set(jax.local_devices())
+    arr = mesh.devices
+    return sorted(d for d in range(arr.shape[0])
+                  if any(dev in local for dev in arr[d].flat))
+
+
+def local_batch_rows(mesh: Mesh, global_batch: int) -> tuple[int, list[int]]:
+    """(local_batch_size, owned global row indices) for this process under
+    `batch_sharding`: P("data") places contiguous row blocks in data-axis
+    coordinate order, so process-local rows are the blocks of its coords.
+
+    When a data coordinate's devices span several processes those processes
+    are *replicas* of that batch shard and must supply identical data
+    (jax's make_array contract) — `process_seed` makes their host rng
+    streams identical. The one unsupported layout is a process owning
+    several coords of which only some span processes (rows would differ
+    between the replica peers): rejected explicitly.
+    """
+    data = mesh.shape["data"]
+    if global_batch % data:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data axis {data}")
+    per = global_batch // data
+    coords = process_data_coords(mesh)
+    local = set(jax.local_devices())
+    spans = [d for d in coords
+             if any(dev not in local for dev in mesh.devices[d].flat)]
+    if spans and len(coords) > 1:
+        raise ValueError(
+            f"data coords {spans} span processes while this process owns "
+            f"{coords}: replica peers would load different rows. Pick a "
+            "mesh where spatial*time divides the per-host device count")
+    rows = [r for d in coords for r in range(d * per, (d + 1) * per)]
+    return len(rows), rows
+
+
+def process_seed(mesh: Mesh, seed: int) -> int:
+    """Host-sampling seed: decorrelated across data shards, *identical*
+    for processes that are replicas of the same data coordinate (their
+    devices share coords, so they must feed identical batches)."""
+    coords = process_data_coords(mesh)
+    return seed + (min(coords) if coords else 0)
+
+
+def put_global(batch: dict, sharding: NamedSharding) -> dict:
+    """Place a host-local numpy batch under `sharding`.
+
+    Single-process: plain device_put. Multi-process (hosts spanning the
+    mesh over DCN): each process contributes only its local rows
+    (`local_batch_rows`) and the global array is assembled without any
+    cross-host copy of the full batch — this is what lets each host load
+    1/num_hosts of the data (SURVEY.md §5.8). Leaves that are already
+    device-resident jax.Arrays (on-device augmentation output) are
+    split into per-device shards and moved device-to-device — no
+    host readback on the hot input path.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+
+    def place(x):
+        if isinstance(x, jax.Array):
+            return _assemble_from_local_array(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def _assemble_from_local_array(x: jax.Array, sharding: NamedSharding):
+    """Build the global batch array from this process's already-on-device
+    local-rows array without a device->host roundtrip."""
+    mesh = sharding.mesh
+    gshape = (_global_rows(mesh, x.shape[0]),) + x.shape[1:]
+    _, rows = local_batch_rows(mesh, gshape[0])
+    row_pos = {r: i for i, r in enumerate(rows)}
+    shards = []
+    for dev, idx in sharding.addressable_devices_indices_map(gshape).items():
+        rsl = idx[0] if idx else slice(None)
+        start, stop = rsl.start or 0, rsl.stop if rsl.stop is not None else gshape[0]
+        lsl = slice(row_pos[start], row_pos[stop - 1] + 1)
+        shards.append(jax.device_put(x[lsl], dev))
+    return jax.make_array_from_single_device_arrays(gshape, sharding, shards)
+
+
+def _global_rows(mesh: Mesh, local_rows: int) -> int:
+    """Global batch size implied by this process's local row count."""
+    n_coords = len(process_data_coords(mesh))
+    if local_rows % max(n_coords, 1):
+        raise ValueError(f"local batch {local_rows} not divisible by "
+                         f"owned data coords {n_coords}")
+    return (local_rows // max(n_coords, 1)) * mesh.shape["data"]
+
+
+def put_global_from_full(batch: dict, mesh: Mesh,
+                         sharding: NamedSharding) -> dict:
+    """Like `put_global`, but every process holds the SAME full batch
+    (deterministic val loading): each contributes only its own rows."""
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+
+    def place(x):
+        x = np.asarray(x)
+        _, rows = local_batch_rows(mesh, x.shape[0])
+        return jax.make_array_from_process_local_data(sharding, x[rows])
+
+    return jax.tree_util.tree_map(place, batch)

@@ -115,6 +115,23 @@ class Trainer:
         self.eval_fn = make_eval_fn(self.model, cfg, self.dataset.mean,
                                     mesh=self.mesh,
                                     smooth_border_mask=smooth_border)
+        if jax.process_count() > 1:
+            # Multi-host eval: every host loads the same full val batch
+            # (deterministic), contributes its rows to the global array,
+            # and allgathers outputs so host-side AEE sees the full batch.
+            from jax.experimental import multihost_utils
+
+            from ..parallel.mesh import put_global_from_full
+
+            raw_eval, mesh_ = self.eval_fn, self.mesh
+
+            def eval_fn_mh(params, batch):
+                batch = put_global_from_full(batch, mesh_,
+                                             batch_sharding(mesh_))
+                return {k: multihost_utils.process_allgather(v, tiled=True)
+                        for k, v in raw_eval(params, batch).items()}
+
+            self.eval_fn = eval_fn_mh
         self._augment = None  # set by enable_augmentation()
 
     def enable_augmentation(self) -> None:
@@ -123,8 +140,20 @@ class Trainer:
 
             self._augment = make_augment_fn(self.cfg.data)
 
+    def _local_train_batch_size(self) -> int:
+        """Rows this host loads per step. Single-process: the full batch.
+        Multi-host: only the rows of this process's data-axis shards — each
+        host loads 1/num_hosts of the data (SURVEY.md §5.8); hosts draw
+        from decorrelated rng streams (see fit())."""
+        if jax.process_count() == 1:
+            return self.cfg.data.batch_size
+        from ..parallel.mesh import local_batch_rows
+
+        n, _ = local_batch_rows(self.mesh, self.cfg.data.batch_size)
+        return n
+
     def _next_train_batch(self, it: int, rng: np.random.RandomState) -> dict:
-        batch = self.dataset.sample_train(self.cfg.data.batch_size, rng=rng)
+        batch = self.dataset.sample_train(self._local_train_batch_size(), rng=rng)
         if self._augment is not None:
             batch = self._augment(batch, np.int64(rng.randint(0, 2**31)))
         return batch
@@ -143,7 +172,12 @@ class Trainer:
             max_steps: int | None = None) -> dict[str, float]:
         cfg = self.cfg
         self.enable_augmentation()
-        rng = np.random.RandomState(cfg.train.seed)
+        # decorrelate host sampling across data shards; processes that are
+        # replicas of one data coord get IDENTICAL streams (jax's
+        # make_array replica contract — parallel/mesh.py process_seed)
+        from ..parallel.mesh import process_seed
+
+        rng = np.random.RandomState(process_seed(self.mesh, cfg.train.seed))
         sharding = batch_sharding(self.mesh)
         it_holder = {"i": 0}
 

@@ -113,3 +113,88 @@ def test_halo_exchange_ring():
     np.testing.assert_array_equal(out[:, 14:16], x[:, 8:10])
     # edge shards: zero halos at the outer borders
     assert (out[:, 0:2] == 0).all() and (out[:, -2:] == 0).all()
+
+
+def test_local_batch_rows_single_process():
+    from deepof_tpu.parallel.mesh import (
+        local_batch_rows, process_data_coords, put_global, batch_sharding)
+
+    mesh = build_mesh(MeshConfig())  # data=8 on the CPU test mesh
+    assert process_data_coords(mesh) == list(range(8))
+    n, rows = local_batch_rows(mesh, 16)
+    assert n == 16 and rows == list(range(16))
+
+    mesh2 = build_mesh(MeshConfig(spatial=2))  # data=4
+    n, rows = local_batch_rows(mesh2, 8)
+    assert n == 8 and rows == list(range(8))
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="not divisible"):
+        local_batch_rows(mesh, 7)
+
+
+def test_local_batch_rows_simulated_multihost(monkeypatch):
+    """Monkeypatch jax.local_devices to emulate a host owning only
+    data-coords {2, 3}: its rows must be that contiguous block."""
+    from deepof_tpu.parallel import mesh as M
+
+    mesh = build_mesh(MeshConfig())  # (8, 1, 1)
+    subset = list(mesh.devices[2:4].flat)
+    monkeypatch.setattr(jax, "local_devices", lambda: subset)
+    assert M.process_data_coords(mesh) == [2, 3]
+    n, rows = M.local_batch_rows(mesh, 16)
+    assert n == 4 and rows == [4, 5, 6, 7]
+
+
+def test_put_global_single_process_matches_device_put():
+    from deepof_tpu.parallel.mesh import (
+        batch_sharding, put_global, put_global_from_full)
+
+    mesh = build_mesh(MeshConfig())
+    sh = batch_sharding(mesh)
+    batch = {"source": np.arange(8 * 4, dtype=np.float32).reshape(8, 4)}
+    a = put_global(batch, sh)
+    b = put_global_from_full(batch, mesh, sh)
+    np.testing.assert_array_equal(np.asarray(a["source"]), batch["source"])
+    np.testing.assert_array_equal(np.asarray(b["source"]), batch["source"])
+    assert a["source"].sharding.is_equivalent_to(sh, 2)
+
+
+def test_assemble_from_local_array_single_process():
+    """D2D global assembly from an on-device local-rows array (the
+    multi-process hot path for augmented batches), exercised on the
+    8-device mesh where local rows == global rows."""
+    from deepof_tpu.parallel.mesh import (
+        _assemble_from_local_array, batch_sharding)
+
+    mesh = build_mesh(MeshConfig())
+    sh = batch_sharding(mesh)
+    x = jnp.arange(16 * 3, dtype=jnp.float32).reshape(16, 3)
+    out = _assemble_from_local_array(x, sh)
+    assert out.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert out.sharding.is_equivalent_to(sh, 2)
+
+
+def test_process_seed_and_span_guard(monkeypatch):
+    from deepof_tpu.parallel import mesh as M
+
+    mesh = build_mesh(MeshConfig(spatial=2))  # (4, 2, 1)
+    assert M.process_seed(mesh, 7) == 7  # single process: min coord 0
+
+    # replica emulation: a host owning exactly one spanning coord is OK
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [mesh.devices[1, 0, 0]])
+    assert M.process_data_coords(mesh) == [1]
+    n, rows = M.local_batch_rows(mesh, 8)
+    assert n == 2 and rows == [2, 3]
+    assert M.process_seed(mesh, 7) == 8  # seed + coord, shared by replicas
+
+    # partial span across multiple owned coords is ambiguous: reject
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda: [mesh.devices[0, 0, 0], mesh.devices[0, 1, 0],
+                 mesh.devices[1, 0, 0]])
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="span processes"):
+        M.local_batch_rows(mesh, 8)
