@@ -59,6 +59,13 @@ def _build_cfg(args) -> ExperimentConfig:
         cfg = cfg.replace(data=dataclasses.replace(cfg.data, data_path=args.data_path))
     if args.log_dir:
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, log_dir=args.log_dir))
+    if getattr(args, "synthetic", False):
+        # before --set so explicit overrides win over smoke-test defaults
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, dataset="synthetic", image_size=(64, 64),
+            gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
+            train=dataclasses.replace(cfg.train, eval_batch_size=8,
+                                      eval_amplifier=1.0))
     for item in args.set or []:
         if "=" not in item:
             raise SystemExit(f"bad --set {item!r}: use section.field=value")
@@ -147,12 +154,6 @@ def main(argv=None) -> int:
         return 0
 
     cfg = _build_cfg(args)
-    if getattr(args, "synthetic", False):
-        cfg = cfg.replace(data=dataclasses.replace(
-            cfg.data, dataset="synthetic", image_size=(64, 64),
-            gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
-            train=dataclasses.replace(cfg.train, eval_batch_size=8,
-                                      eval_amplifier=1.0))
     if args.cmd == "config":
         print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
         return 0

@@ -107,6 +107,11 @@ class TrainConfig:
     eval_batch_size: int = 8
     nan_guard: bool = True
     dump_visuals: bool = False
+    # Another run's log_dir to transfer-initialize from on fresh starts:
+    # params with matching path+shape are grafted (trunk transfers; pr
+    # heads / first conv re-init when T differs). The Chairs->Sintel
+    # fine-tune path (reference paper recipe; BASELINE.json north star).
+    init_from: str = ""
     # Path to the public `vgg16_weights.npz`; when set, VGG-trunk models
     # start from these conv weights with first-layer in-channel duplication
     # (reference `flyingChairsTrain.py:60-76,142-145`, `ucf101train.py:68-88`

@@ -15,22 +15,29 @@ import re
 import shutil
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 from .state import TrainState
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, create: bool = True):
+        """create=False opens read-only (no mkdir side effect — e.g. the
+        transfer-init source, where a typo'd path must not leave a phantom
+        empty run directory behind)."""
         self.directory = os.path.abspath(directory)
         self.keep = keep
-        os.makedirs(self.directory, exist_ok=True)
+        if create:
+            os.makedirs(self.directory, exist_ok=True)
         self._ckpt = ocp.PyTreeCheckpointer()
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:010d}")
 
     def all_steps(self) -> list[int]:
+        if not os.path.isdir(self.directory):
+            return []
         steps = []
         for name in os.listdir(self.directory):
             m = re.match(r"step_(\d+)$", name)
@@ -67,3 +74,65 @@ class CheckpointManager:
             return None
         restored = self._ckpt.restore(self._path(step), item=template)
         return restored.replace(tx=template.tx)
+
+    def restore_raw(self, step: int | None = None,
+                    subtree: str | None = None) -> dict | None:
+        """Restore the checkpoint as a raw pytree (no template) — for
+        cross-config transfer where structures differ (`transfer_params`).
+
+        subtree: restore only that top-level entry (e.g. "params"),
+        skipping the rest — Adam moments are ~2x the param bytes, so a
+        params-only transfer read is ~3x cheaper. Falls back to a full
+        read if selective restore isn't supported by the orbax version.
+        """
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        path = self._path(step)
+        if subtree is not None:
+            try:
+                meta = self._ckpt.metadata(path)
+                skip = jax.tree_util.tree_map(
+                    lambda m: ocp.RestoreArgs(restore_type=None), meta)
+                if isinstance(skip, dict) and subtree in skip:
+                    skip[subtree] = jax.tree_util.tree_map(
+                        lambda m: ocp.RestoreArgs(), meta[subtree])
+                    return self._ckpt.restore(path, restore_args=skip)[subtree]
+            except Exception:  # noqa: BLE001 - orbax API drift: full read
+                pass
+            return self._ckpt.restore(path)[subtree]
+        return self._ckpt.restore(path)
+
+
+def transfer_params(target: dict, source: dict) -> tuple[dict, int, int]:
+    """Graft `source` leaves onto `target` where path AND shape match.
+
+    The cross-config fine-tune path (e.g. FlyingChairs 2-frame pretrain ->
+    Sintel T=10 volume model, the reference paper's training recipe): trunk
+    weights transfer; the first conv (3T input channels) and the pyramid
+    heads / flow upsamplers (2(T-1) channels) re-initialize. Returns
+    (new_target, n_copied, n_skipped) where skipped counts target leaves
+    with no same-shape source counterpart.
+    """
+    copied = skipped = 0
+
+    def graft(tgt, src):
+        nonlocal copied, skipped
+        if isinstance(tgt, dict):
+            return {
+                k: (graft(v, src[k]) if isinstance(src, dict) and k in src
+                    else _skip(v))
+                for k, v in tgt.items()
+            }
+        if (src is not None and hasattr(src, "shape")
+                and getattr(tgt, "shape", None) == src.shape):
+            copied += 1
+            return jnp.asarray(src, dtype=tgt.dtype)
+        return _skip(tgt)
+
+    def _skip(sub):
+        nonlocal skipped
+        skipped += len(jax.tree_util.tree_leaves(sub))
+        return sub
+
+    return graft(target, source), copied, skipped

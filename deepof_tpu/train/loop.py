@@ -74,6 +74,26 @@ class Trainer:
             self.logger.log("info", 0,
                             message=f"VGG16 trunk init from {cfg.train.vgg16_npz}")
 
+        # Cross-config transfer init (Chairs -> Sintel fine-tune recipe):
+        # graft matching-shape params from another run; fresh starts only.
+        if cfg.train.init_from and self.ckpt.latest_step() is None:
+            from .checkpoint import transfer_params
+
+            src_params = CheckpointManager(
+                cfg.train.init_from + "/ckpt",
+                create=False).restore_raw(subtree="params")
+            if src_params is None:
+                raise FileNotFoundError(
+                    f"train.init_from: no checkpoint under "
+                    f"{cfg.train.init_from}/ckpt")
+            params, n_copied, n_skipped = transfer_params(
+                self.state.params, src_params)
+            self.state = self.state.replace(params=params)
+            self.logger.log(
+                "info", 0,
+                message=f"transfer init from {cfg.train.init_from}: "
+                        f"{n_copied} tensors copied, {n_skipped} re-init")
+
         restored = self.ckpt.restore(self.state)
         if restored is not None:
             self.state = restored

@@ -202,3 +202,43 @@ def test_every_model_family_trains(tmp_path, model_name, weights, smoothness):
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["total"]))
     assert float(metrics["grad_norm"]) > 0
+
+
+def test_transfer_init_chairs_to_sintel_shapes(tmp_path):
+    """Cross-config transfer: 2-frame FlowNet-S pretrain -> T=4 volume
+    model. Trunk convs graft; first conv (3T in-ch) and pyramid heads
+    (2(T-1) out-ch) re-initialize."""
+    import dataclasses
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.train.loop import Trainer
+
+    src_dir = str(tmp_path / "chairs")
+    cfg = get_config("flyingchairs").replace(model="flownet_s")
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64),
+                                 batch_size=4, crop_size=None),
+        train=dataclasses.replace(cfg.train, log_dir=src_dir,
+                                  eval_batch_size=4, eval_amplifier=1.0))
+    src_tr = Trainer(cfg)
+    src_tr.ckpt.save(src_tr.state)
+    src_params = src_tr.state.params
+
+    tgt_dir = str(tmp_path / "sintel")
+    tcfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, time_step=4),
+        train=dataclasses.replace(cfg.train, log_dir=tgt_dir,
+                                  eval_batch_size=4, eval_amplifier=1.0,
+                                  init_from=src_dir))
+    tgt_tr = Trainer(tcfg)
+    tp = tgt_tr.state.params
+
+    # trunk conv2 transferred exactly
+    np.testing.assert_array_equal(
+        np.asarray(tp["conv2"]["Conv_0"]["kernel"]),
+        np.asarray(src_params["conv2"]["Conv_0"]["kernel"]))
+    # first conv re-initialized (in-ch 12 vs 6: shapes differ)
+    assert tp["conv1"]["Conv_0"]["kernel"].shape[2] == 12
+    # pyramid head re-initialized (6 flow channels vs 2)
+    assert tp["decoder"]["pr1"]["Conv_0"]["kernel"].shape[-1] == 6
