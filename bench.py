@@ -32,9 +32,9 @@ def emit(value: float, vs_baseline: float, error: str | None = None) -> None:
 
 
 def _init_devices(timeout_s: float = 240.0):
-    """Backend init with a watchdog: a wedged device tunnel must produce a
-    JSON error line, not an infinite hang (the axon claim loop can block
-    forever if the relay is down).
+    """Backend init with a watchdog: raises TimeoutError instead of
+    hanging forever when the device tunnel is wedged (the axon claim loop
+    can block indefinitely if the relay is down).
 
     Limitation: if the container's sitecustomize itself hangs at
     interpreter startup (its register() blocks reading a relay-helper
@@ -54,9 +54,8 @@ def _init_devices(timeout_s: float = 240.0):
     t.join(timeout_s)
     if "devices" in out:
         return out["devices"]
-    err = out.get("error", f"backend init exceeded {timeout_s:.0f}s")
-    emit(0.0, 0.0, error=f"accelerator unavailable: {err}")
-    sys.exit(0)
+    raise TimeoutError(
+        out.get("error", f"backend init exceeded {timeout_s:.0f}s"))
 
 
 import jax  # noqa: E402
@@ -111,7 +110,12 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
 
 
 def main() -> None:
-    res = bench()
+    try:
+        res = bench()
+    except TimeoutError as e:
+        # harness contract: always ONE JSON line; nonzero exit flags failure
+        emit(0.0, 0.0, error=f"accelerator unavailable: {e}")
+        sys.exit(1)
     baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     if os.path.exists(baseline_path):

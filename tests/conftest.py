@@ -23,6 +23,10 @@ from jax._src import xla_bridge  # noqa: E402
 
 xla_bridge._backend_factories.pop("axon", None)
 
+# The suite is XLA-compile-dominated (multi-device train steps on the CPU
+# mesh); a persistent cache cuts repeat runs from minutes to seconds.
+jax.config.update("jax_compilation_cache_dir", "/tmp/deepof_tpu_jax_cache")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
