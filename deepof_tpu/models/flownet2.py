@@ -1,0 +1,68 @@
+"""FlowNetCS — stacked flow refinement (FlowNet 2.0, arXiv:1612.01925 §3).
+
+New capability beyond the reference (which stops at single-stage nets):
+a FlowNet-C base estimate is upsampled to input resolution, frame 2 is
+backward-warped by it (reusing `ops.warp.backward_warp`, the framework's
+loss kernel), and a FlowNet-S refinement stage consumes
+[img1, img2, warped img2, flow, brightness error] (12 channels) to
+predict the residual-corrected pyramid.
+
+Adaptation notes (documented divergences from the paper):
+  - trained end-to-end with the unsupervised pyramid loss on the
+    refinement stage's outputs — gradients reach the base network through
+    the warp's flow input (the paper trains stages sequentially with
+    supervised EPE; there is no ground truth in this framework's
+    training regime);
+  - 2-frame only (the multi-frame volume path pairs naturally with the
+    single-stage models).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.warp import backward_warp
+from .flownet_c import FlowNetC
+from .flownet_s import FLOW_SCALES, FlowNetS
+
+
+class FlowNetCS(nn.Module):
+    flow_channels: int = 2
+    max_disp: int = 20
+    corr_stride: int = 2
+    dtype: Any = jnp.float32
+
+    flow_scales: tuple[float, ...] = FLOW_SCALES
+
+    @nn.compact
+    def __call__(self, pair: jnp.ndarray) -> list[jnp.ndarray]:
+        if pair.shape[-1] != 6 or self.flow_channels != 2:
+            raise ValueError(
+                "FlowNetCS is a 2-frame model (6 input channels, 2 flow "
+                f"channels); got input {pair.shape[-1]}ch / "
+                f"{self.flow_channels} flow channels")
+        b, h, w, _ = pair.shape
+        img1, img2 = pair[..., :3], pair[..., 3:]
+
+        base = FlowNetC(flow_channels=2, max_disp=self.max_disp,
+                        corr_stride=self.corr_stride, dtype=self.dtype,
+                        flow_scales=self.flow_scales, name="base")(pair)
+        # finest base level lives at half resolution; x2 the vectors when
+        # upsampling to input resolution (the eval-amplifier convention,
+        # `flyingChairsTrain.py:264`)
+        flow = base[0].astype(jnp.float32) * self.flow_scales[0]
+        flow = jax.image.resize(flow, (b, h, w, 2), "bilinear") * 2.0
+
+        warped = backward_warp(img2.astype(jnp.float32), flow)
+        err = jnp.sqrt(jnp.sum(jnp.square(img1.astype(jnp.float32) - warped),
+                               axis=-1, keepdims=True) + 1e-12)
+        refine_in = jnp.concatenate(
+            [img1, img2, warped.astype(self.dtype), flow.astype(self.dtype),
+             err.astype(self.dtype)], axis=-1)
+        return FlowNetS(flow_channels=2, dtype=self.dtype,
+                        flow_scales=self.flow_scales,
+                        name="refine")(refine_in)

@@ -12,6 +12,7 @@ from .flownet_s import FlowNetS
 from .vgg16_flow import VGG16Flow
 from .inception_v3_flow import InceptionV3Flow
 from .flownet_c import FlowNetC
+from .flownet2 import FlowNetCS
 from .two_stream import STBaseline, STSingle, UCF101Spatial
 
 MODELS = {
@@ -19,6 +20,7 @@ MODELS = {
     "vgg16": VGG16Flow,
     "inception_v3": InceptionV3Flow,
     "flownet_c": FlowNetC,
+    "flownet_cs": FlowNetCS,
     "st_single": STSingle,
     "st_baseline": STBaseline,
     "ucf101_spatial": UCF101Spatial,

@@ -246,3 +246,30 @@ def test_load_vgg16_npz_relu_trunk(tmp_path, rng):
     trunk = loaded["spatial"]["conv5_3"]
     tgt = trunk.get("Conv_0", trunk)
     np.testing.assert_array_equal(np.asarray(tgt["kernel"]), data["conv5_3_W"])
+
+
+def test_flownet_cs_stacked_refinement():
+    """FlowNet-CS (FlowNet2-style stack): base + warp-fed refinement;
+    gradients reach the base network through the warp's flow input."""
+    model = build_model("flownet_cs", max_disp=4)  # small corr for test speed
+    x = jnp.zeros((1, H, W, 6))
+    variables, flows = _init_apply(model, x)
+    assert len(flows) == 6
+    assert flows[0].shape == (1, H // 2, W // 2, 2)
+    assert {"base", "refine"} <= set(variables["params"].keys())
+
+    rng = np.random.RandomState(0)
+    xr = jnp.asarray(rng.rand(1, H, W, 6), jnp.float32)
+
+    def loss(params):
+        f = model.apply({"params": params}, xr)
+        return jnp.sum(jnp.square(f[0]))
+
+    grads = jax.grad(loss)(variables["params"])
+    gbase = max(float(jnp.abs(g).max())
+                for g in jax.tree_util.tree_leaves(grads["base"]))
+    assert gbase > 0.0, "no gradient reached the base stage through the warp"
+
+    with pytest.raises(ValueError, match="2-frame"):
+        build_model("flownet_cs", flow_channels=4, max_disp=4).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, H, W, 12)))
