@@ -51,17 +51,20 @@ def postprocess_flow(flow: np.ndarray, cfg: ExperimentConfig,
 
 def dump_visuals(out_dir: str, tag: str, flow: np.ndarray,
                  recon: np.ndarray | None = None,
-                 gt: np.ndarray | None = None) -> None:
-    """Write flow-color / reconstruction / GT images for sample 0."""
+                 gt: np.ndarray | None = None,
+                 max_samples: int = 8) -> None:
+    """Write flow-color / reconstruction / GT images per sample (the
+    reference dumps one set per val clip, `sintelTrain.py:283-307`)."""
     os.makedirs(out_dir, exist_ok=True)
-    cv2.imwrite(os.path.join(out_dir, f"{tag}_flow.png"),
-                flow_to_color(flow[0, :, :, :2]))
-    if gt is not None:
-        cv2.imwrite(os.path.join(out_dir, f"{tag}_gt.png"),
-                    flow_to_color(gt[0, :, :, :2]))
-    if recon is not None:
-        img = np.clip(recon[0, :, :, :3] * 255.0, 0, 255).astype(np.uint8)
-        cv2.imwrite(os.path.join(out_dir, f"{tag}_recon.png"), img)
+    for i in range(min(flow.shape[0], max_samples)):
+        cv2.imwrite(os.path.join(out_dir, f"{tag}_s{i}_flow.png"),
+                    flow_to_color(flow[i, :, :, :2]))
+        if gt is not None:
+            cv2.imwrite(os.path.join(out_dir, f"{tag}_s{i}_gt.png"),
+                        flow_to_color(gt[i, :, :, :2]))
+        if recon is not None:
+            img = np.clip(recon[i, :, :, :3] * 255.0, 0, 255).astype(np.uint8)
+            cv2.imwrite(os.path.join(out_dir, f"{tag}_s{i}_recon.png"), img)
 
 
 def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
@@ -70,6 +73,10 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
     bs = cfg.train.eval_batch_size
     n_batches = max(dataset.num_val // bs, 1)
     epes, aaes, totals = [], [], []
+    # running aggregates (O(1) memory — the val split at native res is GBs)
+    p_sum = g_sum = 0.0
+    p_n = g_n = 0
+    p_max = g_max = 0.0
     for bid in range(n_batches):
         batch = dataset.sample_val(bs, bid)
         out = {k: np.asarray(v) for k, v in eval_fn(params, batch).items()}
@@ -81,13 +88,21 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
             epes.append(float(flow_epe(pred[..., p : p + 2], gt[..., p : p + 2])))
             aaes.append(float(flow_aae(pred[..., p : p + 2], gt[..., p : p + 2])))
         totals.append(float(out["total"]))
+        pa, ga = np.abs(pred), np.abs(gt)
+        p_sum += float(pa.sum()); p_n += pa.size; p_max = max(p_max, float(pa.max()))
+        g_sum += float(ga.sum()); g_n += ga.size; g_max = max(g_max, float(ga.max()))
         if dump_dir and bid == 0:
             dump_visuals(dump_dir, f"val{bid}", pred,
                          out.get("recon"), gt)
+    # flow-statistics report (reference `flyingChairsTrain.py:298-312`)
     return {
         "aee": float(np.mean(epes)),
         "aae": float(np.mean(aaes)),
         "val_loss": float(np.mean(totals)),
+        "pred_abs_mean": p_sum / max(p_n, 1),
+        "pred_abs_max": p_max,
+        "gt_abs_mean": g_sum / max(g_n, 1),
+        "gt_abs_max": g_max,
     }
 
 
