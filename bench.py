@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import threading
 import time
 
@@ -23,18 +22,24 @@ METRIC = "flyingchairs_train_pairs_per_sec_per_chip"
 UNIT = "image-pairs/sec/chip"
 
 
-def emit(value: float, vs_baseline: float, error: str | None = None) -> None:
+def emit(value: float, vs_baseline: float, error: str | None = None,
+         **extra) -> None:
     line = {"metric": METRIC, "value": round(value, 2), "unit": UNIT,
             "vs_baseline": round(vs_baseline, 3)}
+    line.update(extra)
     if error:
         line["error"] = error
-    print(json.dumps(line))
+    # flush: os._exit in main() skips interpreter shutdown, so a buffered
+    # line (stdout = pipe under the harness) would otherwise be lost
+    print(json.dumps(line), flush=True)
 
 
-def _init_devices(timeout_s: float = 240.0):
-    """Backend init with a watchdog: raises TimeoutError instead of
-    hanging forever when the device tunnel is wedged (the axon claim loop
-    can block indefinitely if the relay is down).
+def _watchdog(fn, timeout_s: float, what: str):
+    """Run fn() on a daemon thread; raise TimeoutError on hang or error.
+
+    A wedged relay can block the axon claim loop AND remote compiles
+    indefinitely, and a stuck C++ thread cannot be interrupted — the
+    caller must treat a timeout as fatal and exit via os._exit.
 
     Limitation: if the container's sitecustomize itself hangs at
     interpreter startup (its register() blocks reading a relay-helper
@@ -43,19 +48,23 @@ def _init_devices(timeout_s: float = 240.0):
     """
     out: dict = {}
 
-    def probe():
+    def work():
         try:
-            out["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001
+            out["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - report, don't vanish
             out["error"] = f"{type(e).__name__}: {e}"
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=work, daemon=True)
     t.start()
     t.join(timeout_s)
-    if "devices" in out:
-        return out["devices"]
+    if "value" in out:
+        return out["value"]
     raise TimeoutError(
-        out.get("error", f"backend init exceeded {timeout_s:.0f}s"))
+        out.get("error", f"{what} exceeded {timeout_s:.0f}s (wedged tunnel?)"))
+
+
+def _init_devices(timeout_s: float = 240.0):
+    return _watchdog(lambda: jax.devices(), timeout_s, "backend init")
 
 
 import jax  # noqa: E402
@@ -63,8 +72,41 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 
+def calibrate(n: int = 4096, reps: int = 10) -> dict:
+    """Raw bf16 matmul rate + host<->device RTT, to contextualize the
+    headline number: the chip is reached through a shared tunnel whose
+    throughput and latency swing over minutes (observed 30-130 TFLOP/s
+    and 0.1-66 ms RTT on the same binary)."""
+    a = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(x):
+        return (x @ x).sum()
+
+    out = mm(a)  # compile mm AND the chaining ops used in the timed loop
+    out = mm(out * 0 + a)
+    float(jax.device_get(out))
+    # RTT must round-trip a FRESH array: device_get on an already-fetched
+    # one returns jax's cached host copy without touching the tunnel.
+    # (warm the scalar-add compile first so RTT is transfer, not compile)
+    float(jax.device_get(jax.device_put(jnp.float32(1.0)) + 1.0))
+    t1 = time.perf_counter()
+    float(jax.device_get(jax.device_put(jnp.float32(2.0)) + 1.0))
+    rtt = time.perf_counter() - t1
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = mm(out * 0 + a)  # chain to prevent overlap-free reordering
+    float(jax.device_get(out))
+    # subtract the one value-fetch round trip so a 66ms-RTT tunnel does
+    # not masquerade as a slow chip (compute here is only ~reps*4ms)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9) / reps
+    return {"matmul_tflops": round(2 * n**3 / dt / 1e12, 1),
+            "rtt_ms": round(rtt * 1e3, 2)}
+
+
 def bench(model_name: str = "inception_v3", batch: int = 16,
-          image_size=(320, 448), steps: int = 20, warmup: int = 3) -> dict:
+          image_size=(320, 448), steps: int = 20, warmup: int = 3,
+          windows: int = 4) -> dict:
     from deepof_tpu.core.config import (
         DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
     from deepof_tpu.data.datasets import SyntheticData
@@ -94,36 +136,57 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
 
     for _ in range(warmup):
         state, metrics = step(state, b)
-    jax.block_until_ready(metrics["total"])
+    total = float(jax.device_get(metrics["total"]))
+    assert np.isfinite(total)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, b)
-    jax.block_until_ready(metrics["total"])
-    dt = time.perf_counter() - t0
+    # Timing honesty: end every window by FETCHING the final loss value.
+    # The value transitively depends on every dispatched step, so it cannot
+    # materialize early — unlike `block_until_ready`, whose readiness event
+    # has been observed to fire before execution completes on the tunneled
+    # backend (apparent >1 PFLOP/s on a ~200 TFLOP/s chip). Best of several
+    # windows then measures the code, not the neighbors on a shared chip.
+    best_dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, b)
+        total = float(jax.device_get(metrics["total"]))
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    dt = best_dt
 
     pairs_per_sec = steps * batch / dt
     per_chip = pairs_per_sec / n_chips
-    assert np.isfinite(float(jax.device_get(metrics["total"])))
+    assert np.isfinite(total)
     return {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
-            "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt}
+            "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt,
+            **calibrate()}
 
 
-def main() -> None:
+def main(deadline_s: float = 1500.0) -> None:
+    """Run the whole bench under a wall-clock watchdog. The init watchdog
+    alone is not enough: a wedged relay can also hang the *remote compile*
+    (observed), and a stuck C++ compile thread cannot be interrupted — so
+    the final line is printed from the main thread and the process exits
+    with os._exit, skipping atexit hooks a dead tunnel would block."""
     try:
-        res = bench()
+        res = _watchdog(bench, deadline_s, "bench")
     except TimeoutError as e:
-        # harness contract: always ONE JSON line; nonzero exit flags failure
-        emit(0.0, 0.0, error=f"accelerator unavailable: {e}")
-        sys.exit(1)
-    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+        emit(0.0, 0.0, error=str(e))
+        os._exit(1)
     vs = 1.0
-    if os.path.exists(baseline_path):
+    try:
+        baseline_path = os.path.join(os.path.dirname(__file__),
+                                     "BENCH_BASELINE.json")
         with open(baseline_path) as f:
             base = json.load(f).get("pairs_per_sec_per_chip")
         if base:
             vs = res["pairs_per_sec_per_chip"] / base
-    emit(res["pairs_per_sec_per_chip"], vs)
+    except Exception:  # noqa: BLE001 - missing/corrupt baseline: still emit
+        vs = 1.0
+    emit(res["pairs_per_sec_per_chip"], vs,
+         matmul_tflops=res["matmul_tflops"], rtt_ms=res["rtt_ms"],
+         batch=res["batch"])
+    os._exit(0)
 
 
 if __name__ == "__main__":
