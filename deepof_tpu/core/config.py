@@ -123,6 +123,11 @@ class TrainConfig:
     # instead of storing them — trades FLOPs for HBM (for high-res /
     # long-T configs that would not otherwise fit).
     remat: bool = False
+    # Optimizer steps per jit call (lax.scan over stacked batches). >1
+    # amortizes per-dispatch host/RTT overhead — significant on tunneled
+    # or remote device transports (DESIGN.md "Benchmark honesty") — at
+    # the cost of log/eval granularity rounding up to a multiple of K.
+    steps_per_call: int = 1
 
 
 @dataclass(frozen=True)

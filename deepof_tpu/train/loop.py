@@ -198,13 +198,33 @@ class Trainer:
         from ..parallel.mesh import process_seed
 
         rng = np.random.RandomState(process_seed(self.mesh, cfg.train.seed))
-        sharding = batch_sharding(self.mesh)
+        k = max(cfg.train.steps_per_call, 1)
+        if k == 1:
+            sharding = batch_sharding(self.mesh)
+        else:
+            from ..parallel.mesh import stacked_batch_sharding
+
+            sharding = stacked_batch_sharding(self.mesh)
         it_holder = {"i": 0}
 
+        def _stack(xs):
+            # on-device augmentation output stays on device (D2D stack);
+            # np.stack would silently read full image batches back to host
+            if isinstance(xs[0], jax.Array):
+                return jnp.stack(xs)
+            return np.stack(xs)
+
         def produce():
-            b = self._next_train_batch(it_holder["i"], rng)
-            it_holder["i"] += 1
-            return b
+            if k == 1:
+                b = self._next_train_batch(it_holder["i"], rng)
+                it_holder["i"] += 1
+                return b
+            # steps_per_call: K batches stacked on a leading scan axis
+            bs = []
+            for _ in range(k):
+                bs.append(self._next_train_batch(it_holder["i"], rng))
+                it_holder["i"] += 1
+            return {key: _stack([b[key] for b in bs]) for key in bs[0]}
 
         prefetch = Prefetcher(produce, depth=cfg.data.prefetch, sharding=sharding)
         timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
@@ -218,7 +238,19 @@ class Trainer:
                 self.ckpt.save(self.state)  # rollback target before step 1
             self.profiler.maybe_start()
             first_step = True
-            for step in range(start_step, total_steps):
+
+            def _crossed(prev: int, new: int, every: int) -> bool:
+                return every > 0 and prev // every != new // every
+
+            def _scalar_last(v) -> float:
+                """Last inner step's value (arrays carry a leading K axis
+                when steps_per_call > 1)."""
+                a = np.asarray(jax.device_get(v))
+                return float(a) if a.ndim == 0 else float(a[-1])
+
+            gstep = start_step
+            consecutive_nans = 0
+            while gstep < total_steps:
                 batch = prefetch.get()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
                     import time as _time
@@ -227,40 +259,49 @@ class Trainer:
                     self.state, metrics = self.train_step(self.state, batch)
                     jax.block_until_ready(metrics["total"])
                     self.logger.log(
-                        "info", step + 1,
+                        "info", gstep + k,
                         message=f"first step (compile + run): "
                                 f"{_time.perf_counter() - t0:.1f}s")
                     first_step = False
                 else:
                     self.state, metrics = self.train_step(self.state, batch)
-                timer.tick()
-                epoch = (step + 1) // self.steps_per_epoch
-                end_of_epoch = (step + 1) % self.steps_per_epoch == 0
-                log_due = (step + 1) % cfg.train.log_every == 0 or end_of_epoch
-                eval_due = end_of_epoch or (
-                    cfg.train.eval_every and (step + 1) % cfg.train.eval_every == 0)
+                timer.tick(k)
+                prev, gstep = gstep, gstep + k
+                epoch = gstep // self.steps_per_epoch
+                end_of_epoch = _crossed(prev, gstep, self.steps_per_epoch)
+                log_due = _crossed(prev, gstep, cfg.train.log_every) or end_of_epoch
+                eval_due = end_of_epoch or _crossed(prev, gstep,
+                                                    cfg.train.eval_every)
 
                 # NaN guard runs on every host-visible step (log or eval), so
                 # divergence never reaches an eval record; at most
                 # log_every-1 steps of NaN training are lost to the rollback.
                 if (log_due or eval_due) and cfg.train.nan_guard:
-                    total = float(jax.device_get(metrics["total"]))
-                    if not np.isfinite(total):
-                        self._rollback(step)
+                    if not np.isfinite(
+                            np.asarray(jax.device_get(metrics["total"]))).all():
+                        self._rollback(gstep)
+                        gstep = int(self.state.step)
+                        consecutive_nans += 1
+                        if consecutive_nans >= 3:
+                            raise FloatingPointError(
+                                f"loss diverged to NaN {consecutive_nans} "
+                                f"consecutive times around step {gstep}; "
+                                "rollback is not recovering — aborting")
                         continue
+                    consecutive_nans = 0
 
                 if log_due:
-                    total = float(jax.device_get(metrics["total"]))
                     self.logger.log(
-                        "train", step + 1, epoch=epoch, loss=total,
-                        lr=float(self.schedule(step)),
-                        grad_norm=float(jax.device_get(metrics["grad_norm"])),
-                        **{k: jax.device_get(v) for k, v in metrics.items()
-                           if k in ("action_loss", "accuracy")},
+                        "train", gstep, epoch=epoch,
+                        loss=_scalar_last(metrics["total"]),
+                        lr=float(self.schedule(gstep - 1)),
+                        grad_norm=_scalar_last(metrics["grad_norm"]),
+                        **{key: _scalar_last(v) for key, v in metrics.items()
+                           if key in ("action_loss", "accuracy")},
                         **timer.rates())
                 if eval_due:
                     last_eval = self.evaluate(dump=cfg.train.dump_visuals)
-                    self.logger.log("eval", step + 1, epoch=epoch, **last_eval)
+                    self.logger.log("eval", gstep, epoch=epoch, **last_eval)
                     timer.pause()  # eval time is not training throughput
                 if end_of_epoch and epoch % cfg.train.ckpt_every_epochs == 0:
                     self.ckpt.save(self.state)

@@ -67,11 +67,12 @@ class StepTimer:
         self._elapsed = 0.0
         self._steps = 0
 
-    def tick(self) -> None:
+    def tick(self, n: int = 1) -> None:
+        """Record n completed steps (n>1 for steps_per_call batched calls)."""
         now = time.perf_counter()
         if self._last is not None:
             self._elapsed += now - self._last
-            self._steps += 1
+            self._steps += n
         self._last = now
 
     def pause(self) -> None:

@@ -127,7 +127,14 @@ def model_losses(
 
 def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
                     smooth_border_mask: bool = False):
-    """Build the jitted, sharded train step: (state, batch) -> (state, metrics)."""
+    """Build the jitted, sharded train step: (state, batch) -> (state, metrics).
+
+    With `cfg.train.steps_per_call = K > 1` the returned fn instead takes K
+    stacked batches ([K, B, ...] leaves) and runs K optimizer steps in one
+    call via `lax.scan`, returning metrics with a leading K axis. One
+    dispatch + one value fetch then serves K steps — amortizing per-step
+    host/transport overhead (DESIGN.md "Benchmark honesty").
+    """
     compute_dtype = jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16" else jnp.float32
 
     def step(state: TrainState, batch):
@@ -154,9 +161,23 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
         return new_state, metrics
 
     repl, data = replicated_sharding(mesh), batch_sharding(mesh)
+    k = max(cfg.train.steps_per_call, 1)
+    if k == 1:
+        return jax.jit(
+            step,
+            in_shardings=(repl, data),
+            out_shardings=(repl, repl),
+            donate_argnums=(0,),
+        )
+
+    from ..parallel.mesh import stacked_batch_sharding
+
+    def multi_step(state: TrainState, batches):
+        return jax.lax.scan(step, state, batches)
+
     return jax.jit(
-        step,
-        in_shardings=(repl, data),
+        multi_step,
+        in_shardings=(repl, stacked_batch_sharding(mesh)),
         out_shardings=(repl, repl),
         donate_argnums=(0,),
     )
