@@ -97,6 +97,11 @@ class TrainConfig:
     log_every: int = 500
     eval_every: int = 5000  # steps; 0 = only at epoch end
     ckpt_every_epochs: int = 18
+    # Step-granularity checkpointing (0 = epoch cadence only). The
+    # reference saves only every N epochs and restarts its LR schedule on
+    # resume (SURVEY.md §5.3-5.4); step cadence bounds work lost to
+    # preemption to ckpt_every_steps steps.
+    ckpt_every_steps: int = 0
     keep_ckpts: int = 3
     seed: int = 0
     log_dir: str = "/tmp/deepof_tpu"

@@ -208,11 +208,14 @@ class Trainer:
         it_holder = {"i": 0}
 
         def _stack(xs):
-            # on-device augmentation output stays on device (D2D stack);
-            # np.stack would silently read full image batches back to host
-            if isinstance(xs[0], jax.Array):
+            # On-device augmentation output stays on device (D2D stack);
+            # np.stack would silently read full image batches back to host.
+            # Multi-process must take the host path: put_global's
+            # device-array assembly treats axis 0 as the data-sharded batch
+            # axis, which the stacked [K, B, ...] layout violates.
+            if isinstance(xs[0], jax.Array) and jax.process_count() == 1:
                 return jnp.stack(xs)
-            return np.stack(xs)
+            return np.stack([np.asarray(x) for x in xs])
 
         def produce():
             if k == 1:
@@ -244,8 +247,8 @@ class Trainer:
 
             def _scalar_last(v) -> float:
                 """Last inner step's value (arrays carry a leading K axis
-                when steps_per_call > 1)."""
-                a = np.asarray(jax.device_get(v))
+                when steps_per_call > 1); v is already host-side."""
+                a = np.asarray(v)
                 return float(a) if a.ndim == 0 else float(a[-1])
 
             gstep = start_step
@@ -273,12 +276,23 @@ class Trainer:
                 eval_due = end_of_epoch or _crossed(prev, gstep,
                                                     cfg.train.eval_every)
 
-                # NaN guard runs on every host-visible step (log or eval), so
-                # divergence never reaches an eval record; at most
-                # log_every-1 steps of NaN training are lost to the rollback.
-                if (log_due or eval_due) and cfg.train.nan_guard:
-                    if not np.isfinite(
-                            np.asarray(jax.device_get(metrics["total"]))).all():
+                ckpt_due = (end_of_epoch
+                            and epoch % cfg.train.ckpt_every_epochs == 0)
+                ckpt_due = ckpt_due or _crossed(prev, gstep,
+                                                cfg.train.ckpt_every_steps)
+
+                # One host fetch serves the NaN guard, logging, and the
+                # pre-checkpoint health check (per-metric fetches would
+                # each pay a transport round trip — DESIGN.md).
+                m_host = (jax.device_get(metrics)
+                          if (log_due or eval_due or ckpt_due) else None)
+
+                # NaN guard runs on every host-visible step (log, eval, or
+                # checkpoint), so divergence never reaches an eval record
+                # and a NaN state is never saved as a rollback target; at
+                # most log_every-1 steps of NaN training are lost.
+                if m_host is not None and cfg.train.nan_guard:
+                    if not np.isfinite(np.asarray(m_host["total"])).all():
                         self._rollback(gstep)
                         gstep = int(self.state.step)
                         consecutive_nans += 1
@@ -293,17 +307,17 @@ class Trainer:
                 if log_due:
                     self.logger.log(
                         "train", gstep, epoch=epoch,
-                        loss=_scalar_last(metrics["total"]),
+                        loss=_scalar_last(m_host["total"]),
                         lr=float(self.schedule(gstep - 1)),
-                        grad_norm=_scalar_last(metrics["grad_norm"]),
-                        **{key: _scalar_last(v) for key, v in metrics.items()
+                        grad_norm=_scalar_last(m_host["grad_norm"]),
+                        **{key: _scalar_last(v) for key, v in m_host.items()
                            if key in ("action_loss", "accuracy")},
                         **timer.rates())
                 if eval_due:
                     last_eval = self.evaluate(dump=cfg.train.dump_visuals)
                     self.logger.log("eval", gstep, epoch=epoch, **last_eval)
                     timer.pause()  # eval time is not training throughput
-                if end_of_epoch and epoch % cfg.train.ckpt_every_epochs == 0:
+                if ckpt_due:
                     self.ckpt.save(self.state)
                     timer.pause()
             self.profiler.maybe_stop()

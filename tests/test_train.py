@@ -188,6 +188,20 @@ def test_steps_per_call_matches_single(tmp_path):
         single_params, state2.params)
 
 
+def test_ckpt_every_steps(tmp_path):
+    """Step-granularity checkpoints: saves land mid-epoch, not just at
+    epoch/ckpt_every_epochs boundaries (SURVEY.md §5.3)."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, ckpt_every_steps=2, ckpt_every_epochs=10**6,
+        nan_guard=False))
+    trainer = Trainer(cfg, profile=False)
+    trainer.fit(num_epochs=1, max_steps=4)
+    assert trainer.ckpt.latest_step() >= 4  # saved at step cadence (+final)
+
+
 def test_nan_guard_rollback_aborts_after_retries(tmp_path):
     """Persistent divergence must abort (bounded rollbacks), not loop
     forever re-training the same region from the restored checkpoint."""
