@@ -38,7 +38,8 @@ def restore_params(cfg: ExperimentConfig):
     tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
     template = create_train_state(
         model, jnp.zeros((1, h, w, 3 * t)), tx, seed=0)
-    state = CheckpointManager(cfg.train.log_dir + "/ckpt").restore(template)
+    state = CheckpointManager(cfg.train.log_dir + "/ckpt",
+                          async_save=False).restore(template)
     if state is None:
         raise FileNotFoundError(
             f"no checkpoint under {cfg.train.log_dir}/ckpt")

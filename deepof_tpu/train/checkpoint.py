@@ -22,20 +22,41 @@ from .state import TrainState
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 3, create: bool = True):
+    def __init__(self, directory: str, keep: int = 3, create: bool = True,
+                 async_save: bool = True):
         """create=False opens read-only (no mkdir side effect — e.g. the
         transfer-init source, where a typo'd path must not leave a phantom
-        empty run directory behind)."""
+        empty run directory behind).
+
+        async_save: serialize to disk on a background thread — save()
+        returns after the device->host snapshot, so step-cadence
+        checkpointing (`ckpt_every_steps`) doesn't stall training on IO.
+        Every read path (and the next save) waits for the in-flight write,
+        so observable behavior is unchanged; call finalize() before
+        process exit."""
         self.directory = os.path.abspath(directory)
         self.keep = keep
         if create:
             os.makedirs(self.directory, exist_ok=True)
-        self._ckpt = ocp.PyTreeCheckpointer()
+        if async_save:
+            self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        else:
+            self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _wait(self) -> None:
+        wait = getattr(self._ckpt, "wait_until_finished", None)
+        if wait is not None:
+            wait()
+
+    def finalize(self) -> None:
+        """Block until any in-flight async save has fully committed."""
+        self._wait()
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:010d}")
 
     def all_steps(self) -> list[int]:
+        self._wait()  # an in-flight async save must be visible (or absent)
         if not os.path.isdir(self.directory):
             return []
         steps = []
@@ -52,23 +73,31 @@ class CheckpointManager:
 
     def save(self, state: TrainState) -> str:
         step = int(jax.device_get(state.step))
+        self._wait()  # serialize with any still-writing previous save
         path = self._path(step)
         # Multi-host: orbax coordinates the distributed write itself, but
         # directory surgery (clobber + prune) must be single-writer or one
         # host can rmtree a directory another host's writer is mid-write to.
         primary = jax.process_index() == 0
-        if primary and os.path.exists(path):
-            shutil.rmtree(path)
-        self._ckpt.save(path, state)
         if primary:
-            for old in self.all_steps()[: -self.keep]:
-                shutil.rmtree(self._path(old), ignore_errors=True)
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            # Prune BEFORE the (possibly async) write, but always retain
+            # the newest completed checkpoint: if the in-flight write never
+            # commits (crash, disk full), a restorable state must survive.
+            # keep=1 therefore transiently holds 2 checkpoints on disk.
+            done = self.all_steps()  # _wait() already ran above
+            for old in done[: -max(self.keep - 1, 1)]:
+                if old != step:
+                    shutil.rmtree(self._path(old), ignore_errors=True)
+        self._ckpt.save(path, state)
         return path
 
     def restore(self, template: TrainState, step: int | None = None) -> TrainState | None:
         """Restore into the structure of `template` (shapes/dtypes/shardings
         come from the abstract template, the non-pytree `tx` is carried
         over). Returns None if no checkpoint exists."""
+        self._wait()
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
@@ -85,6 +114,7 @@ class CheckpointManager:
         params-only transfer read is ~3x cheaper. Falls back to a full
         read if selective restore isn't supported by the orbax version.
         """
+        self._wait()
         step = step if step is not None else self.latest_step()
         if step is None:
             return None

@@ -80,8 +80,8 @@ class Trainer:
             from .checkpoint import transfer_params
 
             src_params = CheckpointManager(
-                cfg.train.init_from + "/ckpt",
-                create=False).restore_raw(subtree="params")
+                cfg.train.init_from + "/ckpt", create=False,
+                async_save=False).restore_raw(subtree="params")
             if src_params is None:
                 raise FileNotFoundError(
                     f"train.init_from: no checkpoint under "
@@ -324,6 +324,7 @@ class Trainer:
             self.ckpt.save(self.state)
         finally:
             prefetch.close()
+            self.ckpt.finalize()  # commit any in-flight async save
         rates = timer.rates()
         return {**last_eval, **rates}
 
