@@ -61,6 +61,12 @@ class OptimConfig:
     beta2: float = 0.999
     adam_eps: float = 1e-8
     grad_clip_norm: float | None = None
+    # Accumulate gradients over N micro-batches before each optimizer
+    # update (optax.MultiSteps) — effective batch = N * batch_size when
+    # the global batch exceeds HBM even with remat. LR-decay boundaries
+    # stay aligned to data epochs (the schedule is stretched to count
+    # micro-steps).
+    grad_accum: int = 1
 
 
 @dataclass(frozen=True)

@@ -38,10 +38,22 @@ class TrainState:
 
 def make_optimizer(cfg: OptimConfig, schedule: Callable) -> optax.GradientTransformation:
     """Adam with the reference's hyper-parameters (`flyingChairsTrain.py:124`)
-    plus optional global-norm gradient clipping (new capability)."""
-    tx = optax.adam(schedule, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.adam_eps)
+    plus optional global-norm gradient clipping and gradient accumulation
+    (new capabilities)."""
+    accum = max(cfg.grad_accum, 1)
+    if accum > 1:
+        # MultiSteps' inner count advances once per optimizer update (every
+        # `accum` micro-steps); stretch the schedule so LR-decay boundaries
+        # stay at the same number of *data* batches as without accumulation.
+        inner_schedule = lambda count: schedule(count * accum)  # noqa: E731
+    else:
+        inner_schedule = schedule
+    tx = optax.adam(inner_schedule, b1=cfg.beta1, b2=cfg.beta2,
+                    eps=cfg.adam_eps)
     if cfg.grad_clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
     return tx
 
 

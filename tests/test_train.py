@@ -188,6 +188,61 @@ def test_steps_per_call_matches_single(tmp_path):
         single_params, state2.params)
 
 
+def test_grad_accum_matches_large_batch(tmp_path):
+    """Two accumulated micro-batches == one optimizer step on the
+    concatenated batch (losses are batch means, so gradients average)."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data)
+    model = build_model("flownet_s")
+    b0 = ds.sample_train(8, iteration=0)
+    b1 = ds.sample_train(8, iteration=1)
+
+    # accumulation: 2 micro-steps of 8
+    acfg = cfg.replace(optim=dataclasses.replace(cfg.optim, grad_accum=2))
+    tx_a = make_optimizer(acfg.optim, lambda s: 1e-4)
+    state_a = create_train_state(model, jnp.zeros((8, H, W, 6)), tx_a, seed=0)
+    init_params = jax.device_get(state_a.params)
+    step_a = make_train_step(model, acfg, ds.mean, mesh)
+    state_a, _ = step_a(state_a, jax.device_put(b0, batch_sharding(mesh)))
+    mid = jax.device_get(state_a.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, init_params, mid)
+    state_a, _ = step_a(state_a, jax.device_put(b1, batch_sharding(mesh)))
+
+    # ... and the deferred update did land after the 2nd micro-step
+    moved = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a)
+                                  - np.asarray(jax.device_get(b))).max()),
+        init_params, state_a.params))
+    assert max(moved) > 0
+
+    # Exact averaging equivalence needs a gradient-linear optimizer (Adam
+    # normalizes, so any two runs differ by <= 2*lr and the comparison
+    # proves nothing): SGD accum of 2x8 == SGD on the concatenated 16.
+    import optax
+
+    sgd_a = optax.MultiSteps(optax.sgd(1e-2), every_k_schedule=2)
+    state_sa = create_train_state(model, jnp.zeros((8, H, W, 6)), sgd_a, seed=0)
+    step_sa = make_train_step(model, acfg, ds.mean, mesh)
+    for b in (b0, b1):
+        state_sa, _ = step_sa(state_sa, jax.device_put(b, batch_sharding(mesh)))
+
+    big = {k: np.concatenate([b0[k], b1[k]]) for k in b0}
+    bcfg = cfg.replace(data=dataclasses.replace(cfg.data, batch_size=16))
+    state_sb = create_train_state(model, jnp.zeros((16, H, W, 6)),
+                                  optax.sgd(1e-2), seed=0)
+    step_sb = make_train_step(model, bcfg, ds.mean, mesh)
+    state_sb, _ = step_sb(state_sb, jax.device_put(big, batch_sharding(mesh)))
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            rtol=1e-4, atol=1e-5),
+        state_sa.params, state_sb.params)
+
+
 def test_ckpt_every_steps(tmp_path):
     """Step-granularity checkpoints: saves land mid-epoch, not just at
     epoch/ckpt_every_epochs boundaries (SURVEY.md §5.3)."""
