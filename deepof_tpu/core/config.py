@@ -48,6 +48,13 @@ class LossConfig:
     # "pallas" (VMEM row-sweep kernel, W <= 128 only), "auto" (pallas for
     # coarse pyramid levels, XLA for fine — see ops/pallas/warp.py).
     warp_impl: str = "xla"
+    # Photometric penalty: "charbonnier" = the reference's raw-RGB
+    # Charbonnier (`flyingChairsWrapFlow.py:841-851`); "census" = soft
+    # census-transform distance (ops/census.py) — illumination-robust,
+    # the standard quality upgrade in modern unsupervised flow (opt-in;
+    # changes the loss scale, so retune lambda_smooth/weights).
+    photometric: str = "charbonnier"
+    census_window: int = 7
 
 
 @dataclass(frozen=True)

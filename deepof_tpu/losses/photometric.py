@@ -49,13 +49,16 @@ def charbonnier(x: jnp.ndarray, eps: float, alpha: float) -> jnp.ndarray:
     return jnp.power(jnp.square(x) + eps * eps, alpha)
 
 
-def border_mask(h: int, w: int, ratio: float = 0.1) -> jnp.ndarray:
+def border_mask(h: int, w: int, ratio: float = 0.1,
+                min_width: int = 0) -> jnp.ndarray:
     """(H, W) float mask: 0 in a ceil(ratio*H)-wide border, 1 inside.
 
     The border width derives from H only ("shortestDim",
-    `flyingChairsWrapFlow.py:763-765`).
+    `flyingChairsWrapFlow.py:763-765`). min_width widens the border for
+    penalties whose neighborhoods exceed it (census windows at coarse
+    levels).
     """
-    bw = int(math.ceil(h * ratio))
+    bw = max(int(math.ceil(h * ratio)), min_width)
     m = jnp.zeros((h, w))
     return m.at[bw : h - bw, bw : w - bw].set(1.0)
 
@@ -107,15 +110,30 @@ def loss_interp(
     recon = backward_warp(outputs, scaled, impl=cfg.warp_impl)
 
     bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)
-    diff = 255.0 * (recon - inputs)
-    ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
     # guard: at very coarse pyramid levels (h <= 2) the border mask has no
     # interior (the reference never ran levels this small); such a level
     # contributes exactly 0 to photometric AND smoothness terms.
     n_interior = jnp.sum(bmask)
-    level_on = (n_interior > 0).astype(ele.dtype)
+    level_on = (n_interior > 0).astype(inputs.dtype)
     num_valid = jnp.maximum(b * c * n_interior, 1.0)
-    photo = jnp.sum(ele) / num_valid
+    if cfg.photometric == "census":
+        from ..ops.census import census_distance, census_transform
+
+        # census neighborhoods reach window//2 pixels: widen the mask so
+        # edge-replicated descriptor components never enter the loss
+        # (at coarse levels ceil(0.1*h) can be narrower than the window)
+        cmask = border_mask(h, w, cfg.border_ratio,
+                            min_width=cfg.census_window // 2)
+        dist = census_distance(census_transform(recon, cfg.census_window),
+                               census_transform(inputs, cfg.census_window))
+        ele = dist * cmask[None, :, :, None]
+        photo = jnp.sum(ele) / jnp.maximum(b * jnp.sum(cmask), 1.0)
+    elif cfg.photometric == "charbonnier":
+        diff = 255.0 * (recon - inputs)
+        ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
+        photo = jnp.sum(ele) / num_valid
+    else:
+        raise ValueError(f"unknown photometric variant {cfg.photometric!r}")
 
     sflow = scaled if cfg.smooth_scaled_flow else flow
     mx = smoothness_mask_x(h, w)[None, :, :, None]
