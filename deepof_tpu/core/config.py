@@ -55,6 +55,19 @@ class LossConfig:
     # changes the loss scale, so retune lambda_smooth/weights).
     photometric: str = "charbonnier"
     census_window: int = 7
+    # Forward-backward occlusion masking (opt-in; UnFlow/UFlow lineage):
+    # the model also runs on the swapped pair, and pixels failing the
+    # fw/bw consistency check |f_fw + warp(f_bw)|^2 <
+    # occ_alpha*(|f_fw|^2+|warp(f_bw)|^2) + occ_beta are excluded from
+    # the photometric term (their appearance is unobservable in the other
+    # frame). Costs a second forward pass. Flow-only 2-frame models.
+    occlusion: bool = False
+    occ_alpha: float = 0.01
+    occ_beta: float = 0.5
+    # Per-occluded-pixel penalty (added as occ_penalty * occluded interior
+    # fraction). Must be > 0: with a free mask the degenerate optimum is
+    # to declare hard regions occluded (UnFlow's lambda_p guard).
+    occ_penalty: float = 1.0
 
 
 @dataclass(frozen=True)

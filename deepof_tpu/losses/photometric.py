@@ -91,6 +91,26 @@ def _edge_aware_masks(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return 1.0 - jnp.abs(gx), 1.0 - jnp.abs(gy)
 
 
+def occlusion_mask(flow_fw: jnp.ndarray, flow_bw: jnp.ndarray,
+                   cfg: LossConfig) -> jnp.ndarray:
+    """Forward-backward consistency visibility mask (1 = visible).
+
+    flow_fw/flow_bw: (B, h, w, 2) already flow_scale-multiplied. A pixel
+    is occluded when the backward flow sampled at its forward-displaced
+    position does not cancel the forward flow:
+        |f_fw + warp(f_bw, f_fw)|^2 >= occ_alpha*(|f_fw|^2 + |warp(f_bw)|^2)
+                                       + occ_beta
+    (UnFlow eq. 2 lineage). Returns (B, h, w, 1).
+    """
+    bw_at_fw = backward_warp(flow_bw, flow_fw, impl=cfg.warp_impl)
+    sq = jnp.sum(jnp.square(flow_fw + bw_at_fw), axis=-1, keepdims=True)
+    bound = cfg.occ_alpha * (
+        jnp.sum(jnp.square(flow_fw), axis=-1, keepdims=True)
+        + jnp.sum(jnp.square(bw_at_fw), axis=-1, keepdims=True)
+    ) + cfg.occ_beta
+    return (sq < bound).astype(flow_fw.dtype)
+
+
 def loss_interp(
     flow: jnp.ndarray,
     inputs: jnp.ndarray,
@@ -98,12 +118,15 @@ def loss_interp(
     flow_scale: float,
     cfg: LossConfig,
     smooth_border_mask: bool = False,
+    occ_mask: jnp.ndarray | None = None,
 ) -> tuple[LossDict, jnp.ndarray]:
     """Two-frame photometric + smoothness loss at one pyramid scale.
 
     flow: (B, h, w, 2) raw head output; inputs/outputs: (B, h, w, C)
-    LRN-normalized prev/next frames resized to this scale. Returns
-    (loss dict, reconstructed prev frame).
+    LRN-normalized prev/next frames resized to this scale. occ_mask:
+    optional (B, h, w, 1) visibility weights multiplying the photometric
+    term (occluded pixels drop out of both the sum and the normalizer).
+    Returns (loss dict, reconstructed prev frame).
     """
     b, h, w, c = inputs.shape
     scaled = flow * flow_scale
@@ -122,16 +145,35 @@ def loss_interp(
         # census neighborhoods reach window//2 pixels: widen the mask so
         # edge-replicated descriptor components never enter the loss
         # (at coarse levels ceil(0.1*h) can be narrower than the window)
-        cmask = border_mask(h, w, cfg.border_ratio,
-                            min_width=cfg.census_window // 2)
+        cmask = jnp.broadcast_to(
+            border_mask(h, w, cfg.border_ratio,
+                        min_width=cfg.census_window // 2)[None, :, :, None],
+            (b, h, w, 1))
+        vis = cmask
+        if occ_mask is not None:
+            vis = cmask * occ_mask
         dist = census_distance(census_transform(recon, cfg.census_window),
                                census_transform(inputs, cfg.census_window))
-        ele = dist * cmask[None, :, :, None]
-        photo = jnp.sum(ele) / jnp.maximum(b * jnp.sum(cmask), 1.0)
+        photo = jnp.sum(dist * vis) / jnp.maximum(jnp.sum(vis), 1.0)
+        if occ_mask is not None:
+            # occluded pixels must not be free (see LossConfig.occ_penalty)
+            photo = photo + cfg.occ_penalty * (
+                jnp.sum(cmask * (1.0 - occ_mask))
+                / jnp.maximum(jnp.sum(cmask), 1.0))
     elif cfg.photometric == "charbonnier":
+        pmask = bmask[None, :, :, None]
+        if occ_mask is not None:
+            pmask = pmask * occ_mask
+            photo_norm = jnp.maximum(c * jnp.sum(pmask), 1.0)
+        else:
+            photo_norm = num_valid
         diff = 255.0 * (recon - inputs)
-        ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
-        photo = jnp.sum(ele) / num_valid
+        ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * pmask
+        photo = jnp.sum(ele) / photo_norm
+        if occ_mask is not None:
+            photo = photo + cfg.occ_penalty * (
+                jnp.sum(bmask[None, :, :, None] * (1.0 - occ_mask))
+                / jnp.maximum(b * n_interior, 1.0))
     else:
         raise ValueError(f"unknown photometric variant {cfg.photometric!r}")
 

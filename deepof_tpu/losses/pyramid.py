@@ -22,7 +22,12 @@ import jax.numpy as jnp
 
 from ..core.config import LossConfig
 from ..ops.lrn import local_response_normalization
-from .photometric import LossDict, loss_interp, loss_interp_multi
+from .photometric import (
+    LossDict,
+    loss_interp,
+    loss_interp_multi,
+    occlusion_mask,
+)
 
 
 def preprocess(images: jnp.ndarray, mean) -> jnp.ndarray:
@@ -47,8 +52,13 @@ def pyramid_loss(
     outputs_norm: jnp.ndarray,
     cfg: LossConfig,
     smooth_border_mask: bool = False,
+    flow_pyramid_bw: list[jnp.ndarray] | None = None,
 ) -> tuple[jnp.ndarray, list[LossDict], jnp.ndarray]:
     """flow_pyramid: [(flow_k, flow_scale_k)] finest first.
+
+    flow_pyramid_bw: optional matching backward-flow pyramid (raw head
+    outputs, same scales) enabling per-scale fw/bw occlusion masking of
+    the photometric term (`LossConfig.occlusion`).
 
     Returns (weighted_total, per-scale loss dicts finest first, finest
     reconstruction).
@@ -60,7 +70,11 @@ def pyramid_loss(
         h, w = flow.shape[1:3]
         li = _resize(inputs_norm, h, w)
         lo = _resize(outputs_norm, h, w)
-        ld, recon = loss_interp(flow, li, lo, scale, cfg, smooth_border_mask)
+        occ = None
+        if flow_pyramid_bw is not None:
+            occ = occlusion_mask(flow * scale, flow_pyramid_bw[k] * scale, cfg)
+        ld, recon = loss_interp(flow, li, lo, scale, cfg, smooth_border_mask,
+                                occ_mask=occ)
         losses.append(ld)
         if k == 0:
             recon_finest = recon

@@ -109,10 +109,16 @@ def model_losses(
     else:
         flows = fwd(pair)
 
+    flows_bw = None
+    if loss_cfg.occlusion and not is_two_stream:
+        # fw/bw occlusion masking: second forward on the swapped pair
+        # (LossConfig.occlusion; costs one extra model evaluation)
+        flows_bw = fwd(jnp.concatenate([net_tgt, net_src], axis=-1))
+
     pyramid = list(zip(flows, model.flow_scales))
     total, losses, recon = pyramid_loss(
         pyramid, lrn_normalize(src), lrn_normalize(tgt), loss_cfg,
-        smooth_border_mask=smooth_border_mask)
+        smooth_border_mask=smooth_border_mask, flow_pyramid_bw=flows_bw)
     aux.update(losses=losses, flow=flows[0] * model.flow_scales[0], recon=recon)
 
     if is_two_stream:
@@ -136,6 +142,15 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
     host/transport overhead (DESIGN.md "Benchmark honesty").
     """
     compute_dtype = jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16" else jnp.float32
+
+    if cfg.loss.occlusion and (
+            getattr(model, "has_action_head", False)
+            or getattr(model, "classifier_only", False)
+            or cfg.data.time_step > 2):
+        raise ValueError(
+            "loss.occlusion=true supports only flow-only 2-frame models; "
+            f"model={cfg.model!r} time_step={cfg.data.time_step} would "
+            "silently skip the masking")
 
     def step(state: TrainState, batch):
         rng, dropout_rng = jax.random.split(state.rng)

@@ -111,6 +111,61 @@ def test_census_photometric(rng):
     assert np.isfinite(np.asarray(g)).all()
 
 
+def test_occlusion_mask_and_loss(rng):
+    """Consistent fw/bw flows stay visible; inconsistent regions drop out
+    of the photometric term (and its normalizer)."""
+    from deepof_tpu.losses.photometric import occlusion_mask
+
+    cfg = _loss_cfg()
+    h, w = 16, 20
+    # constant translation u=+2: backward flow -2 exactly cancels
+    fw = jnp.zeros((1, h, w, 2)).at[..., 0].set(2.0)
+    bw = jnp.zeros((1, h, w, 2)).at[..., 0].set(-2.0)
+    occ = occlusion_mask(fw, bw, cfg)
+    # interior fully visible (warp clip only disturbs the last columns)
+    assert float(jnp.mean(occ[:, :, : w - 3, :])) == 1.0
+
+    # contradictory backward flow -> occluded everywhere
+    occ_bad = occlusion_mask(fw, fw * 3.0, cfg)
+    assert float(jnp.mean(occ_bad)) < 0.2
+
+    # masked photometric: occluded pixels leave sum AND normalizer
+    img1 = jnp.asarray(rng.rand(1, h, w, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(1, h, w, 3).astype(np.float32))
+    flow = jnp.zeros((1, h, w, 2))
+    ld_all, _ = loss_interp(flow, img1, img2, 1.0, cfg,
+                            occ_mask=jnp.ones((1, h, w, 1)))
+    ld_none, _ = loss_interp(flow, img1, img2, 1.0, cfg,
+                             occ_mask=jnp.zeros((1, h, w, 1)))
+    ld_plain, _ = loss_interp(flow, img1, img2, 1.0, cfg)
+    assert np.isclose(float(ld_all["Charbonnier_reconstruct"]),
+                      float(ld_plain["Charbonnier_reconstruct"]), rtol=1e-6)
+    # fully-occluded = no reconstruction term, only the per-pixel penalty
+    # (occluded interior fraction = 1.0) — occlusion is never free
+    assert np.isclose(float(ld_none["Charbonnier_reconstruct"]),
+                      cfg.occ_penalty, rtol=1e-6)
+
+
+def test_pyramid_loss_occlusion_end_to_end(rng):
+    """pyramid_loss with a backward pyramid runs and masking changes the
+    photometric total (inconsistent bw flow masks pixels out)."""
+    from deepof_tpu.losses.pyramid import pyramid_loss
+
+    img1 = jnp.asarray(rng.rand(2, 16, 24, 3).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(2, 16, 24, 3).astype(np.float32))
+    cfg = _loss_cfg()
+    flows = [jnp.asarray(rng.rand(2, 16 // s, 24 // s, 2).astype(np.float32))
+             for s in (1, 2)]
+    pyr = list(zip(flows, (1.0, 2.0)))
+    t_plain, _, _ = pyramid_loss(pyr, img1, img2, cfg)
+    bw = [f * 5.0 for f in flows]  # contradicts fw -> heavy masking
+    t_masked, losses, _ = pyramid_loss(pyr, img1, img2, cfg,
+                                       flow_pyramid_bw=bw)
+    assert np.isfinite(float(t_masked))
+    assert float(t_masked) != float(t_plain)
+    assert all(np.isfinite(float(d["total"])) for d in losses)
+
+
 def test_smoothness_penalizes_rough_flow(rng):
     img = jnp.asarray(rng.rand(1, 12, 16, 3).astype(np.float32))
     smooth_flow = jnp.ones((1, 12, 16, 2))

@@ -188,6 +188,19 @@ def test_steps_per_call_matches_single(tmp_path):
         single_params, state2.params)
 
 
+def test_occlusion_rejected_for_unsupported_models(tmp_path):
+    """loss.occlusion only masks flow-only 2-frame models; anything else
+    must fail at step-build time, not silently skip."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path).replace(model="st_single")
+    cfg = cfg.replace(loss=dataclasses.replace(cfg.loss, occlusion=True))
+    mesh = build_mesh(cfg.mesh)
+    model = build_model("st_single")
+    with pytest.raises(ValueError, match="occlusion"):
+        make_train_step(model, cfg, (0.0, 0.0, 0.0), mesh)
+
+
 def test_grad_accum_matches_large_batch(tmp_path):
     """Two accumulated micro-batches == one optimizer step on the
     concatenated batch (losses are batch means, so gradients average)."""
