@@ -37,6 +37,11 @@ class LossConfig:
     #   `flyingChairsWrapFlow.py:854`); "depthwise": both-direction gradients
     #   per component (`version1/model/warpflow.py:133-136`).
     smoothness: str = "canonical"
+    # 1 = first differences (the reference's prior); 2 = second
+    # differences (opt-in): penalizes flow curvature instead of slope, so
+    # affine motion fields (dominant-plane scenes) are free — a standard
+    # quality knob in modern unsupervised flow.
+    smoothness_order: int = 1
     # Edge-aware Sobel image-gradient weighting of the smoothness term
     # (`loss_interp_bk`, `version1/model/warpflow.py:93-157`).
     edge_aware: bool = False

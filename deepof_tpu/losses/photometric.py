@@ -37,6 +37,8 @@ from ..ops.warp import backward_warp, backward_warp_volume
 from ..ops.smoothness import (
     forward_diff_x,
     forward_diff_y,
+    second_diff_x,
+    second_diff_y,
     sobel_gradients,
     to_grayscale,
 )
@@ -111,6 +113,23 @@ def occlusion_mask(flow_fw: jnp.ndarray, flow_bw: jnp.ndarray,
     return (sq < bound).astype(flow_fw.dtype)
 
 
+def _smoothness_diffs(cfg: LossConfig, h: int, w: int):
+    """(diff_x, diff_y, mask_x, mask_y) for the configured prior order.
+
+    Order 2 penalizes curvature (affine motion fields are free) and
+    invalidates BOTH edge columns/rows of the centered stencil.
+    """
+    if cfg.smoothness_order == 2:
+        mx = (smoothness_mask_x(h, w) * smoothness_mask_x(h, w)[:, ::-1])[None, :, :, None]
+        my = (smoothness_mask_y(h, w) * smoothness_mask_y(h, w)[::-1, :])[None, :, :, None]
+        return second_diff_x, second_diff_y, mx, my
+    if cfg.smoothness_order == 1:
+        mx = smoothness_mask_x(h, w)[None, :, :, None]
+        my = smoothness_mask_y(h, w)[None, :, :, None]
+        return forward_diff_x, forward_diff_y, mx, my
+    raise ValueError(f"unknown smoothness_order {cfg.smoothness_order!r}")
+
+
 def loss_interp(
     flow: jnp.ndarray,
     inputs: jnp.ndarray,
@@ -178,14 +197,13 @@ def loss_interp(
         raise ValueError(f"unknown photometric variant {cfg.photometric!r}")
 
     sflow = scaled if cfg.smooth_scaled_flow else flow
-    mx = smoothness_mask_x(h, w)[None, :, :, None]
-    my = smoothness_mask_y(h, w)[None, :, :, None]
+    diff_x, diff_y, mx, my = _smoothness_diffs(cfg, h, w)
 
     if cfg.smoothness == "canonical":
         # x-diff of U masked at last col, y-diff of V masked at last row;
         # optional border mask pre-Charbonnier (UCF variant).
-        du = forward_diff_x(sflow[..., 0:1]) * mx
-        dv = forward_diff_y(sflow[..., 1:2]) * my
+        du = diff_x(sflow[..., 0:1]) * mx
+        dv = diff_y(sflow[..., 1:2]) * my
         if smooth_border_mask:
             du = du * bmask[None, :, :, None]
             dv = dv * bmask[None, :, :, None]
@@ -196,8 +214,8 @@ def loss_interp(
         # *after* the Charbonnier power; normalizer is 2/3 of the image one
         # (`version1/model/warpflow.py:133-163`).
         num_valid_flow = num_valid / 3.0 * 2.0
-        gx = forward_diff_x(sflow)  # (B,h,w,2): dU/dx, dV/dx
-        gy = forward_diff_y(sflow)
+        gx = diff_x(sflow)  # (B,h,w,2): dU/dx, dV/dx
+        gy = diff_y(sflow)
         u_delta = jnp.stack([gx[..., 0] * mx[..., 0], gy[..., 0] * my[..., 0]], axis=-1)
         v_delta = jnp.stack([gx[..., 1] * mx[..., 0], gy[..., 1] * my[..., 0]], axis=-1)
         ele_u = charbonnier(u_delta, cfg.epsilon, cfg.alpha_s)
@@ -251,11 +269,10 @@ def loss_interp_multi(
     photo = jnp.sum(ele) / num_valid
 
     sflow = scaled if cfg.smooth_scaled_flow else flows
-    mx = smoothness_mask_x(h, w)[None, :, :, None]
-    my = smoothness_mask_y(h, w)[None, :, :, None]
+    diff_x, diff_y, mx, my = _smoothness_diffs(cfg, h, w)
     bflow = bmask[None, :, :, None]
-    du = forward_diff_x(sflow[..., 0::2]) * mx * bflow  # (B,h,w,T-1)
-    dv = forward_diff_y(sflow[..., 1::2]) * my * bflow
+    du = diff_x(sflow[..., 0::2]) * mx * bflow  # (B,h,w,T-1)
+    dv = diff_y(sflow[..., 1::2]) * my * bflow
     u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid * level_on
     v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid * level_on
 

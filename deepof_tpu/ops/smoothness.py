@@ -43,6 +43,23 @@ def forward_diff_y(f: jnp.ndarray) -> jnp.ndarray:
     return f - shifted
 
 
+def second_diff_x(f: jnp.ndarray) -> jnp.ndarray:
+    """Second difference along W: f[x-1] - 2 f[x] + f[x+1], zero fill at
+    both edge columns (the caller masks them out). Zero for any flow
+    affine in x — the 2nd-order smoothness prior penalizes curvature,
+    not slope, so fronto-parallel motion gradients are free."""
+    left = jnp.pad(f[..., :, :-1, :], [(0, 0)] * (f.ndim - 3) + [(0, 0), (1, 0), (0, 0)])
+    right = jnp.pad(f[..., :, 1:, :], [(0, 0)] * (f.ndim - 3) + [(0, 0), (0, 1), (0, 0)])
+    return left - 2.0 * f + right
+
+
+def second_diff_y(f: jnp.ndarray) -> jnp.ndarray:
+    """Second difference along H (see second_diff_x)."""
+    up = jnp.pad(f[..., :-1, :, :], [(0, 0)] * (f.ndim - 3) + [(1, 0), (0, 0), (0, 0)])
+    down = jnp.pad(f[..., 1:, :, :], [(0, 0)] * (f.ndim - 3) + [(0, 1), (0, 0), (0, 0)])
+    return up - 2.0 * f + down
+
+
 def sobel_gradients(gray: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """3x3 Sobel x/y gradients of (B, H, W, 1), SAME zero padding.
 

@@ -111,6 +111,32 @@ def test_census_photometric(rng):
     assert np.isfinite(np.asarray(g)).all()
 
 
+def test_second_order_smoothness(rng):
+    """Affine flow fields pay no 2nd-order penalty (beyond the eps floor)
+    but a nonzero 1st-order one; curvature is penalized by both."""
+    h, w = 12, 16
+    img = jnp.asarray(rng.rand(1, h, w, 3).astype(np.float32))
+    xs = jnp.arange(w, dtype=jnp.float32)[None, None, :, None]
+    affine = jnp.broadcast_to(0.5 * xs, (1, h, w, 2))  # slope, no curvature
+    eps_floor = (1e-4**2) ** 0.37
+
+    cfg1 = _loss_cfg()
+    cfg2 = _loss_cfg(smoothness_order=2)
+    zero = jnp.zeros((1, h, w, 2))
+    base2 = float(loss_interp(zero, img, img, 1.0, cfg2)[0]["U_loss"])
+
+    ld1, _ = loss_interp(affine, img, img, 1.0, cfg1)
+    ld2, _ = loss_interp(affine, img, img, 1.0, cfg2)
+    # slope costs under 1st order...
+    assert float(ld1["U_loss"]) > 2 * eps_floor
+    # ...but an affine field is indistinguishable from zero flow at 2nd order
+    assert np.isclose(float(ld2["U_loss"]), base2, rtol=1e-3)
+
+    rough = jnp.asarray(rng.rand(1, h, w, 2).astype(np.float32)) * 4
+    ldr, _ = loss_interp(rough, img, img, 1.0, cfg2)
+    assert float(ldr["U_loss"]) > 2 * base2
+
+
 def test_occlusion_mask_and_loss(rng):
     """Consistent fw/bw flows stay visible; inconsistent regions drop out
     of the photometric term (and its normalizer)."""
