@@ -1,0 +1,162 @@
+"""TPU perf probe — the DESIGN.md "Open measurements", runnable.
+
+Honest (value-fetch) timings; see DESIGN.md "Benchmark honesty" for why
+`block_until_ready` is not trusted on this transport. Usage:
+
+    python tools/perf_probe.py            # waits for the tunnel, runs all
+    python tools/perf_probe.py --no-wait  # fail fast if tunnel is down
+
+Sections:
+  1. calibration (raw matmul TFLOP/s + RTT)
+  2. warp XLA vs Pallas at coarse/mid levels, fwd and grad
+  3. Inception-v3 train-step decomposition (fwd / fwd+loss / +bwd / full)
+  4. bench.py headline
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import bench as bench_mod  # noqa: E402
+
+
+def wait_for_tunnel(max_s: float) -> None:
+    deadline = time.time() + max_s
+    while True:
+        try:
+            devs = bench_mod._init_devices(timeout_s=120)
+            print("tunnel up:", devs, flush=True)
+            return
+        except TimeoutError as e:
+            if time.time() > deadline:
+                raise SystemExit(f"gave up waiting for tunnel: {e}")
+            print("tunnel down, retrying in 300s", flush=True)
+            time.sleep(300)
+
+
+def timeit(name, fn, *args, steps=10, windows=3, items=None):
+    import jax
+    import jax.numpy as jnp
+
+    out = fn(*args)
+    val = float(jax.device_get(jnp.asarray(out).ravel()[0]))
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        float(jax.device_get(jnp.asarray(out).ravel()[0]))
+        best = min(best, time.perf_counter() - t0)
+    per = best / steps
+    rate = f"  {items / per:9.1f} items/s" if items else ""
+    print(f"{name:44s} {per*1e3:8.2f} ms{rate}  ({val:.4f})", flush=True)
+    return per
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-wait", action="store_true")
+    ap.add_argument("--wait-s", type=float, default=7200)
+    args = ap.parse_args()
+    wait_for_tunnel(0 if args.no_wait else args.wait_s)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.core.config import (
+        DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.losses.pyramid import lrn_normalize, preprocess, pyramid_loss
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.ops.warp import backward_warp
+    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+    from deepof_tpu.train.step import make_train_step, model_losses
+
+    print("calib:", bench_mod.calibrate(), flush=True)
+
+    # ---- warp: XLA vs Pallas (coarse + mid levels)
+    key = jax.random.PRNGKey(0)
+    for (h, w) in [(40, 56), (80, 112)]:
+        img = jax.random.uniform(key, (16, h, w, 3))
+        flow = jax.random.uniform(key, (16, h, w, 2)) * 8 - 4
+        for impl in ("xla", "pallas"):
+            f = jax.jit(lambda i, fl, impl=impl:
+                        backward_warp(i, fl, impl=impl).sum())
+            timeit(f"warp fwd {impl} {h}x{w}", f, img, flow)
+            g = jax.jit(lambda i, fl, impl=impl: jax.grad(
+                lambda q: backward_warp(i, q, impl=impl).sum())(fl).sum())
+            timeit(f"warp grad {impl} {h}x{w}", g, img, flow)
+
+    # ---- inception step decomposition
+    H, W, B = 320, 448, 16
+    cfg = ExperimentConfig(
+        name="probe", model="inception_v3",
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=1.6e-5),
+        data=DataConfig(dataset="synthetic", image_size=(H, W),
+                        gt_size=(H, W), batch_size=B),
+        train=TrainConfig(seed=0, compute_dtype="bfloat16"),
+    )
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data)
+    b = jax.device_put(ds.sample_train(B, iteration=0), batch_sharding(mesh))
+    model = build_model("inception_v3", dtype=jnp.bfloat16)
+    tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
+    state = create_train_state(model, jnp.zeros((B, H, W, 6)), tx, seed=0)
+
+    src = preprocess(b["source"], ds.mean)
+    tgt = preprocess(b["target"], ds.mean)
+    pair = jnp.concatenate([src, tgt], -1).astype(jnp.bfloat16)
+
+    fwd_sum = jax.jit(lambda p, x: sum(
+        f.astype(jnp.float32).sum() for f in model.apply({"params": p}, x)))
+    timeit("inception fwd only", fwd_sum, state.params, pair, items=B)
+
+    fwd_loss = jax.jit(lambda p, bb: model_losses(
+        model, p, bb, ds.mean, cfg.loss, compute_dtype=jnp.bfloat16)[0])
+    timeit("inception fwd+loss", fwd_loss, state.params, b, items=B)
+
+    fwd_loss_grad = jax.jit(lambda p, bb: jax.value_and_grad(
+        lambda q: model_losses(model, q, bb, ds.mean, cfg.loss,
+                               compute_dtype=jnp.bfloat16)[0])(p)[0])
+    timeit("inception fwd+loss+bwd", fwd_loss_grad, state.params, b, items=B)
+
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    state, m = step(state, b)
+    float(jax.device_get(m["total"]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            state, m = step(state, b)
+        float(jax.device_get(m["total"]))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{'full train step':44s} {best/10*1e3:8.2f} ms  "
+          f"{B/(best/10):9.1f} items/s", flush=True)
+
+    flows = jax.jit(lambda p, x: model.apply({"params": p}, x))(state.params, pair)
+    flows = [f.astype(jnp.float32) for f in flows]
+    li, lo = lrn_normalize(src), lrn_normalize(tgt)
+    loss_alone = jax.jit(lambda fl, a, o: pyramid_loss(
+        list(zip(fl, model.flow_scales)), a, o, cfg.loss)[0])
+    timeit("pyramid loss fwd alone", loss_alone, flows, li, lo, items=B)
+
+    loss_grad_alone = jax.jit(lambda fl, a, o: sum(
+        x.sum() for x in jax.grad(lambda q: pyramid_loss(
+            list(zip(q, model.flow_scales)), a, o, cfg.loss)[0])(fl)))
+    timeit("pyramid loss grad (wrt flows)", loss_grad_alone, flows, li, lo,
+           items=B)
+
+    # ---- headline
+    res = bench_mod.bench()
+    print("bench:", {k: round(v, 2) if isinstance(v, float) else v
+                     for k, v in res.items()}, flush=True)
+
+
+if __name__ == "__main__":
+    main()
