@@ -104,9 +104,12 @@ def calibrate(n: int = 4096, reps: int = 10) -> dict:
             "rtt_ms": round(rtt * 1e3, 2)}
 
 
-def bench(model_name: str = "inception_v3", batch: int = 16,
-          image_size=(320, 448), steps: int = 20, warmup: int = 3,
-          windows: int = 4) -> dict:
+def headline_setup(model_name: str = "inception_v3", batch: int = 16,
+                   image_size=(320, 448)):
+    """The headline workload, shared with tools/perf_probe.py so the
+    decomposition there always measures the same config as the headline.
+
+    Returns (cfg, mesh, ds, model, state, step, sharded_batch)."""
     from deepof_tpu.core.config import (
         DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
     from deepof_tpu.data.datasets import SyntheticData
@@ -116,7 +119,6 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     from deepof_tpu.train.step import make_train_step
 
     h, w = image_size
-    n_chips = len(_init_devices())  # watchdog covers every entrypoint
     cfg = ExperimentConfig(
         name="bench",
         model=model_name,
@@ -133,6 +135,15 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     ds = SyntheticData(cfg.data)
     step = make_train_step(model, cfg, ds.mean, mesh)
     b = jax.device_put(ds.sample_train(batch, iteration=0), batch_sharding(mesh))
+    return cfg, mesh, ds, model, state, step, b
+
+
+def bench(model_name: str = "inception_v3", batch: int = 16,
+          image_size=(320, 448), steps: int = 20, warmup: int = 3,
+          windows: int = 4) -> dict:
+    n_chips = len(_init_devices())  # watchdog covers every entrypoint
+    cfg, mesh, ds, model, state, step, b = headline_setup(
+        model_name, batch, image_size)
 
     for _ in range(warmup):
         state, metrics = step(state, b)

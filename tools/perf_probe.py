@@ -16,10 +16,11 @@ Sections:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench as bench_mod  # noqa: E402
 
@@ -32,6 +33,11 @@ def wait_for_tunnel(max_s: float) -> None:
             print("tunnel up:", devs, flush=True)
             return
         except TimeoutError as e:
+            # _watchdog wraps *every* failure in TimeoutError; only actual
+            # hangs ("exceeded Ns") are worth retrying — a permanent error
+            # (misconfigured backend) would otherwise burn the whole wait
+            if "exceeded" not in str(e):
+                raise SystemExit(f"backend failed (not a hang): {e}")
             if time.time() > deadline:
                 raise SystemExit(f"gave up waiting for tunnel: {e}")
             print("tunnel down, retrying in 300s", flush=True)
@@ -39,8 +45,17 @@ def wait_for_tunnel(max_s: float) -> None:
 
 
 def timeit(name, fn, *args, steps=10, windows=3, items=None):
+    """Honest window timing. Each call's input is perturbed by 0 * the
+    previous call's output, so the final value fetch transitively depends
+    on EVERY dispatch in the window — per DESIGN.md "Benchmark honesty",
+    a fetch depending only on the last dispatch undermeasures when
+    earlier dispatches are still in flight."""
     import jax
     import jax.numpy as jnp
+
+    def chain(tree, prev_out):
+        z = jnp.asarray(prev_out).ravel()[0] * 0
+        return jax.tree_util.tree_map(lambda x: x + z.astype(x.dtype), tree)
 
     out = fn(*args)
     val = float(jax.device_get(jnp.asarray(out).ravel()[0]))
@@ -48,7 +63,7 @@ def timeit(name, fn, *args, steps=10, windows=3, items=None):
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(steps):
-            out = fn(*args)
+            out = fn(*args[:-1], chain(args[-1], out))
         float(jax.device_get(jnp.asarray(out).ravel()[0]))
         best = min(best, time.perf_counter() - t0)
     per = best / steps
@@ -67,15 +82,9 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from deepof_tpu.core.config import (
-        DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
-    from deepof_tpu.data.datasets import SyntheticData
     from deepof_tpu.losses.pyramid import lrn_normalize, preprocess, pyramid_loss
-    from deepof_tpu.models.registry import build_model
     from deepof_tpu.ops.warp import backward_warp
-    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
-    from deepof_tpu.train.state import create_train_state, make_optimizer
-    from deepof_tpu.train.step import make_train_step, model_losses
+    from deepof_tpu.train.step import model_losses
 
     print("calib:", bench_mod.calibrate(), flush=True)
 
@@ -92,22 +101,9 @@ def main() -> None:
                 lambda q: backward_warp(i, q, impl=impl).sum())(fl).sum())
             timeit(f"warp grad {impl} {h}x{w}", g, img, flow)
 
-    # ---- inception step decomposition
-    H, W, B = 320, 448, 16
-    cfg = ExperimentConfig(
-        name="probe", model="inception_v3",
-        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
-        optim=OptimConfig(learning_rate=1.6e-5),
-        data=DataConfig(dataset="synthetic", image_size=(H, W),
-                        gt_size=(H, W), batch_size=B),
-        train=TrainConfig(seed=0, compute_dtype="bfloat16"),
-    )
-    mesh = build_mesh(cfg.mesh)
-    ds = SyntheticData(cfg.data)
-    b = jax.device_put(ds.sample_train(B, iteration=0), batch_sharding(mesh))
-    model = build_model("inception_v3", dtype=jnp.bfloat16)
-    tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
-    state = create_train_state(model, jnp.zeros((B, H, W, 6)), tx, seed=0)
+    # ---- inception step decomposition — the EXACT headline workload
+    cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup()
+    B = cfg.data.batch_size
 
     src = preprocess(b["source"], ds.mean)
     tgt = preprocess(b["target"], ds.mean)
@@ -126,7 +122,6 @@ def main() -> None:
                                compute_dtype=jnp.bfloat16)[0])(p)[0])
     timeit("inception fwd+loss+bwd", fwd_loss_grad, state.params, b, items=B)
 
-    step = make_train_step(model, cfg, ds.mean, mesh)
     state, m = step(state, b)
     float(jax.device_get(m["total"]))
     best = float("inf")
