@@ -1,0 +1,45 @@
+"""Force a virtual multi-device CPU platform in this process.
+
+The container's sitecustomize registers an experimental 'axon' TPU backend
+in every interpreter; initializing it (any first `jax.devices()` /
+computation) can hang on a wedged device tunnel, and `JAX_PLATFORMS=cpu`
+env alone does not prevent that once jax is imported. The working defuse —
+used by the test suite and the driver's multi-chip dryrun — is to set the
+host-platform device count, switch the platform via `jax.config`, and drop
+the axon backend factory before first backend init.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+COMPILE_CACHE_DIR = "/tmp/deepof_tpu_jax_cache"
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Redirect jax onto a CPU platform with >= n virtual devices.
+
+    Must run before any backend initialization. If backends are already
+    live (a caller that intentionally initialized real hardware), they are
+    left alone; callers that need n devices should assert on
+    `len(jax.devices())`. Also enables the persistent compilation cache
+    (the workloads behind this helper are XLA-compile-dominated).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    from jax._src import xla_bridge
+
+    if not xla_bridge._backends:
+        jax.config.update("jax_platforms", "cpu")
+        xla_bridge._backend_factories.pop("axon", None)
+    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)

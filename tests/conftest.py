@@ -11,21 +11,16 @@ the time this conftest runs; switching platforms must go through
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-from jax._src import xla_bridge  # noqa: E402
-
-xla_bridge._backend_factories.pop("axon", None)
+from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
 
 # The suite is XLA-compile-dominated (multi-device train steps on the CPU
-# mesh); a persistent cache cuts repeat runs from minutes to seconds.
-jax.config.update("jax_compilation_cache_dir", "/tmp/deepof_tpu_jax_cache")
+# mesh); force_cpu_devices also enables the persistent compilation cache,
+# which cuts repeat runs from minutes to seconds.
+force_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
