@@ -239,6 +239,7 @@ class Trainer:
                 total_steps = min(total_steps, start_step + max_steps)
             if cfg.train.nan_guard and self.ckpt.latest_step() is None:
                 self.ckpt.save(self.state)  # rollback target before step 1
+            ckpt_mark = timer.mark()
             self.profiler.maybe_start()
             first_step = True
 
@@ -253,6 +254,7 @@ class Trainer:
 
             gstep = start_step
             consecutive_nans = 0
+            metrics = None
             while gstep < total_steps:
                 batch = prefetch.get()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
@@ -295,6 +297,12 @@ class Trainer:
                     if not np.isfinite(np.asarray(m_host["total"])).all():
                         self._rollback(gstep)
                         gstep = int(self.state.step)
+                        # discarded steps must not count toward throughput
+                        # (rewind to the restored checkpoint's snapshot);
+                        # log/eval/ckpt boundaries between the rollback
+                        # target and the NaN step will re-fire as gstep
+                        # re-crosses them (duplicate step records downstream)
+                        timer.rewind(ckpt_mark)
                         consecutive_nans += 1
                         if consecutive_nans >= 3:
                             raise FloatingPointError(
@@ -319,9 +327,30 @@ class Trainer:
                     timer.pause()  # eval time is not training throughput
                 if ckpt_due:
                     self.ckpt.save(self.state)
+                    ckpt_mark = timer.mark()
                     timer.pause()
             self.profiler.maybe_stop()
-            self.ckpt.save(self.state)
+            # The final state may include up to log_every-1 steps that no
+            # host-visible NaN check has seen; saving it unchecked would
+            # make a diverged state the newest checkpoint and defeat both
+            # auto-resume and _rollback.
+            final_ok = True
+            if cfg.train.nan_guard and metrics is not None:
+                total = np.asarray(jax.device_get(metrics["total"]))
+                final_ok = bool(np.isfinite(total).all())
+            if final_ok:
+                self.ckpt.save(self.state)
+            else:
+                # don't just suppress the save: leave self.state consistent
+                # with the newest (healthy) checkpoint so callers that keep
+                # using the trainer don't run on diverged params
+                self._rollback(gstep)
+                timer.rewind(ckpt_mark)
+                self.logger.log(
+                    "warn", gstep,
+                    message="non-finite loss at final step; state rolled "
+                            "back to the last good checkpoint instead of "
+                            "saving the diverged state")
         finally:
             prefetch.close()
             self.ckpt.finalize()  # commit any in-flight async save

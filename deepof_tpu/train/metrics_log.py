@@ -91,6 +91,16 @@ class StepTimer:
     def reset(self) -> None:
         self._last, self._elapsed, self._steps = None, 0.0, 0
 
+    def mark(self) -> tuple[float, int]:
+        """Snapshot for `rewind` — taken when a checkpoint is saved."""
+        return (self._elapsed, self._steps)
+
+    def rewind(self, mark: tuple[float, int]) -> None:
+        """Drop the time AND step count accumulated since `mark` (a NaN
+        rollback discards those steps; keeping them would skew rates)."""
+        self._elapsed, self._steps = mark
+        self._last = None
+
 
 class ProfilerSession:
     """Optional `jax.profiler` trace capture around N steps (SURVEY.md §5.1)."""

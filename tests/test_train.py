@@ -288,6 +288,33 @@ def test_nan_guard_rollback_aborts_after_retries(tmp_path):
         trainer.fit(num_epochs=1, max_steps=50)
 
 
+def test_final_save_skipped_on_unchecked_nan(tmp_path):
+    """Divergence in the trailing (never host-checked) steps must not be
+    saved as the newest checkpoint — a poisoned final save would become
+    the auto-resume AND rollback target, defeating both."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    # log_every larger than the run so no in-loop NaN check ever fires
+    cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, log_every=10**6, ckpt_every_epochs=10**6))
+    trainer = Trainer(cfg, profile=False)
+    real_step = trainer.train_step
+
+    def nan_step(state, batch):
+        state, metrics = real_step(state, batch)
+        metrics = dict(metrics)
+        metrics["total"] = jnp.float32(np.nan)
+        return state, metrics
+
+    trainer.train_step = nan_step
+    trainer.fit(num_epochs=1, max_steps=3)
+    # only the pre-step-1 rollback target exists; the poisoned final state
+    # was refused and the in-memory state rolled back to match it
+    assert trainer.ckpt.latest_step() == 0
+    assert int(trainer.state.step) == 0
+
+
 def test_trainer_fit_steps_per_call(tmp_path):
     """Trainer end-to-end with K=2: step accounting, logging, checkpointing."""
     import dataclasses
