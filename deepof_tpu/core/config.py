@@ -45,6 +45,15 @@ class LossConfig:
     # Edge-aware Sobel image-gradient weighting of the smoothness term
     # (`loss_interp_bk`, `version1/model/warpflow.py:93-157`).
     edge_aware: bool = False
+    # needImageGradients (`flyingChairsWrapFlow_vgg.py:226-301`): the
+    # per-sample min-max-normalized Sobel gradient MAGNITUDE of the target
+    # image multiplies the Charbonnier photometric elementwise loss
+    # (gradient-rich pixels emphasized) and its complement (1 - |grad|)
+    # multiplies both smoothness terms (edges may move freely). Charbonnier
+    # photometric, two-frame loss only (multi-frame volume configs are
+    # rejected — the reference feature exists only in the vgg 2-frame
+    # variant).
+    edge_aware_photo: bool = False
     # Smooth the *scaled* flow (canonical `flyingChairsWrapFlow.py:785,854`)
     # vs the raw head output (gen-1 `version1/model/warpflow.py:37,133`).
     smooth_scaled_flow: bool = True

@@ -12,10 +12,11 @@ Split semantics:
     (documented divergence from the reference's wget at
     `flyingChairsLoader.py:31-34`).
   - Sintel: all T-frame sliding windows per clip
-    (`sintelLoader.py:31-45`); val = the first window of each clip, padded
-    with a second window of the first clip to reach 24
-    (`sintelLoader.py:47-70` picks bamboo_2's second window; we pad
-    deterministically from clip 0 — same count, documented).
+    (`sintelLoader.py:31-45`); val = the first window of each clip in
+    sorted-clip order, plus one extra bamboo_2 window starting at frame
+    `time_step` — the reference's exact membership and order
+    (`sintelLoader.py:47-70`: 23 clips + 1 = 24 windows), so EPE numbers
+    are protocol-comparable at the 24-window granularity.
   - UCF-101: clip group number > 7 -> train (`ucf101Loader.py:42-58`);
     train batch = one random frame-pair from each of B distinct random
     classes (`ucf101Loader.py:66-87`).
@@ -223,8 +224,7 @@ class SintelData:
         clips = sorted(os.listdir(img_root))
         self.windows: list[list[str]] = []  # absolute frame paths per window
         self.flow_windows: list[list[str]] = []
-        first_windows: list[int] = []
-        second_windows: list[int] = []
+        val: list[int] = []
         for clip in clips:
             frames = sorted(
                 os.path.join(img_root, clip, f)
@@ -236,20 +236,19 @@ class SintelData:
                 for f in os.listdir(os.path.join(flow_root, clip))
                 if f.endswith(".flo")
             )
-            for s in range(0, len(frames) - self.t + 1):
-                if s == 0:
-                    first_windows.append(len(self.windows))
-                elif s == 1:
-                    second_windows.append(len(self.windows))
+            clip_start = len(self.windows)
+            n_windows = len(frames) - self.t + 1
+            for s in range(0, n_windows):
                 self.windows.append(frames[s : s + self.t])
                 self.flow_windows.append(flows[s : s + self.t - 1])
-        # val = first window of each clip (+ pad to 24 with second windows)
-        val = list(first_windows)
-        for idx in second_windows:
-            if len(val) >= 24:
-                break
-            val.append(idx)
-        self.val_idx = val[:24]
+            # Reference val membership, exactly (`sintelLoader.py:47-70`):
+            # the first window of every clip, and for bamboo_2 one extra
+            # window starting at frame `time_step` (23 clips + 1 = 24).
+            if n_windows > 0:
+                val.append(clip_start)
+            if clip == "bamboo_2" and n_windows > self.t:
+                val.append(clip_start + self.t)
+        self.val_idx = val
         self.train_idx = [i for i in range(len(self.windows)) if i not in set(self.val_idx)]
         self.num_train, self.num_val = len(self.train_idx), len(self.val_idx)
         self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)

@@ -93,6 +93,28 @@ def _edge_aware_masks(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return 1.0 - jnp.abs(gx), 1.0 - jnp.abs(gy)
 
 
+def _photo_gradient_mask(inputs: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample Sobel gradient-magnitude weight for the photometric term.
+
+    Reference `flyingChairsWrapFlow_vgg.py:226-255` (needImageGradients):
+    min-max normalize each sample to integer [0, 255], grayscale, Sobel
+    x/y, gradient magnitude, then per-sample min-max normalize to [0, 1].
+    HIGH at image edges — unlike the smoothness masks (1 - |grad|), this
+    *emphasizes* structured pixels in the Charbonnier sum. Returns
+    (B, H, W, 1).
+    """
+    mn = jnp.min(inputs, axis=(1, 2, 3), keepdims=True)
+    mx = jnp.max(inputs, axis=(1, 2, 3), keepdims=True)
+    img = 255.0 * (inputs - mn) / jnp.maximum(mx - mn, 1e-12)
+    img = jnp.clip(jnp.floor(img), 0.0, 255.0)
+    gray = to_grayscale(img)
+    gx, gy = sobel_gradients(gray)
+    mag = jnp.sqrt(jnp.square(gx) + jnp.square(gy))
+    mmn = jnp.min(mag, axis=(1, 2, 3), keepdims=True)
+    mmx = jnp.max(mag, axis=(1, 2, 3), keepdims=True)
+    return jnp.clip((mag - mmn) / jnp.maximum(mmx - mmn, 1e-12), 0.0, 1.0)
+
+
 def occlusion_mask(flow_fw: jnp.ndarray, flow_bw: jnp.ndarray,
                    cfg: LossConfig) -> jnp.ndarray:
     """Forward-backward consistency visibility mask (1 = visible).
@@ -150,6 +172,10 @@ def loss_interp(
     b, h, w, c = inputs.shape
     scaled = flow * flow_scale
     recon = backward_warp(outputs, scaled, impl=cfg.warp_impl)
+    # needImageGradients (`flyingChairsWrapFlow_vgg.py:226-301`): the same
+    # per-sample gradient-magnitude mask weights the photometric term by
+    # |grad| and BOTH smoothness terms by 1-|grad| (edges may move freely).
+    gmask = _photo_gradient_mask(inputs) if cfg.edge_aware_photo else None
 
     bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)
     # guard: at very coarse pyramid levels (h <= 2) the border mask has no
@@ -188,6 +214,10 @@ def loss_interp(
             photo_norm = num_valid
         diff = 255.0 * (recon - inputs)
         ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * pmask
+        if gmask is not None:
+            # normalizer stays numValidPixels — the weight reduces the sum
+            # only (`flyingChairsWrapFlow_vgg.py:269-276`)
+            ele = ele * gmask
         photo = jnp.sum(ele) / photo_norm
         if occ_mask is not None:
             photo = photo + cfg.occ_penalty * (
@@ -207,8 +237,13 @@ def loss_interp(
         if smooth_border_mask:
             du = du * bmask[None, :, :, None]
             dv = dv * bmask[None, :, :, None]
-        u_loss = jnp.sum(charbonnier(du, cfg.epsilon, cfg.alpha_s)) / num_valid
-        v_loss = jnp.sum(charbonnier(dv, cfg.epsilon, cfg.alpha_s)) / num_valid
+        ele_u = charbonnier(du, cfg.epsilon, cfg.alpha_s)
+        ele_v = charbonnier(dv, cfg.epsilon, cfg.alpha_s)
+        if gmask is not None:
+            ele_u = ele_u * (1.0 - gmask)
+            ele_v = ele_v * (1.0 - gmask)
+        u_loss = jnp.sum(ele_u) / num_valid
+        v_loss = jnp.sum(ele_v) / num_valid
     elif cfg.smoothness == "depthwise":
         # both-direction gradients per component; border mask multiplies
         # *after* the Charbonnier power; normalizer is 2/3 of the image one
@@ -225,6 +260,13 @@ def loss_interp(
             emask = jnp.concatenate([emx, emy], axis=-1)  # (B,h,w,2)
             ele_u = ele_u * emask
             ele_v = ele_v * emask
+        if gmask is not None:
+            # vgg-variant pairing: 1 - magnitude mask, identical for the
+            # x- and y-gradient channels (`flyingChairsWrapFlow_vgg.py:
+            # 259-260,293-301`) — distinct from `edge_aware`'s directional
+            # 1-|gx| / 1-|gy| masks
+            ele_u = ele_u * (1.0 - gmask)
+            ele_v = ele_v * (1.0 - gmask)
         bflow = bmask[None, :, :, None]
         u_loss = jnp.sum(ele_u * bflow) / num_valid_flow
         v_loss = jnp.sum(ele_v * bflow) / num_valid_flow
@@ -255,6 +297,11 @@ def loss_interp_multi(
     channels; smoothness per pair with both smoothness and border masks
     applied pre-Charbonnier; U from even flow channels, V from odd.
     """
+    if cfg.edge_aware_photo:
+        raise ValueError(
+            "loss.edge_aware_photo is two-frame only (the reference's "
+            "needImageGradients exists only in the vgg 2-frame variant); "
+            "the multi-frame volume loss would silently skip it")
     b, h, w, c3t = volume.shape
     t = c3t // 3
     scaled = flows * flow_scale

@@ -86,9 +86,18 @@ def test_sintel_windows_and_volume(tmp_path):
                      image_size=(32, 64), gt_size=(32, 64), time_step=3,
                      sintel_pass="final")
     ds = SintelData(cfg)
-    # 2 clips x (6-3+1) windows = 8 windows; val = first of each clip + pads
+    # 2 clips x (6-3+1) windows = 8 windows
     assert len(ds.windows) == 8
-    assert ds.num_val == min(24, 4)  # 2 first windows + 2 second windows
+    # reference membership pinned (`sintelLoader.py:47-70`): first window
+    # of each clip in sorted order, plus bamboo_2's window starting at
+    # frame time_step — and nothing else
+    assert ds.val_idx == [0, 4, 4 + ds.t]
+    assert [ds.windows[i][0] for i in ds.val_idx] == [
+        str(tmp_path / "training/final/alley_1/frame_0001.png"),
+        str(tmp_path / "training/final/bamboo_2/frame_0001.png"),
+        str(tmp_path / "training/final/bamboo_2/frame_0004.png"),
+    ]
+    assert ds.num_val == 3
     b = ds.sample_train(2, rng=np.random.RandomState(0))
     assert b["volume"].shape == (2, 32, 64, 9)  # 3T channels
     assert b["flow"].shape == (2, 32, 64, 4)  # 2(T-1)
@@ -104,6 +113,54 @@ def test_sintel_crop(tmp_path):
     ds = SintelData(cfg)
     b = ds.sample_train(1, rng=np.random.RandomState(0))
     assert b["volume"].shape == (1, 16, 32, 6)
+
+
+def test_ucf101_eval_at_reference_scale(tmp_path):
+    """The accuracy aggregation path (`evaluate_ucf101`) at the reference's
+    101-class scale (`ucf101train.py:210-223`): one batch per class, every
+    class visited exactly once, accuracy aggregated over all of them."""
+    from deepof_tpu.core.config import (
+        ExperimentConfig, LossConfig, OptimConfig, TrainConfig,
+    )
+    from deepof_tpu.train.evaluate import evaluate_ucf101
+
+    n_cls = 101
+    for ci in range(n_cls):
+        cls = f"Class{ci:03d}"
+        clip = tmp_path / "frames" / cls / f"v_{cls}_g03_c01"  # group 3 = val
+        clip.mkdir(parents=True)
+        for f in range(2):
+            _write_ppm(clip / f"f{f}.jpg", h=8, w=8, seed=ci * 10 + f)
+    cfg = DataConfig(dataset="ucf101", data_path=str(tmp_path),
+                     image_size=(8, 8))
+    ds = UCF101Data(cfg)
+    assert len(ds.val_clips) == n_cls and ds.num_val == n_cls
+
+    exp = ExperimentConfig(
+        name="t", model="st_single", loss=LossConfig(),
+        optim=OptimConfig(), data=cfg,
+        train=TrainConfig(eval_batch_size=4, log_dir=str(tmp_path)))
+    seen_labels = []
+
+    def fake_eval_fn(params, batch):
+        # predict the true class for even class ids, class 0 otherwise
+        seen_labels.append(batch["label"].copy())
+        b = batch["label"].shape[0]
+        logits = np.zeros((b, n_cls), np.float32)
+        for i, lbl in enumerate(batch["label"]):
+            logits[i, int(lbl) if lbl % 2 == 0 else 0] = 1.0
+        return {"logits": logits, "total": 0.5}
+
+    res = evaluate_ucf101(fake_eval_fn, None, ds, exp)
+    labels = np.concatenate(seen_labels)
+    # 101 batches of 4, each from a single class; all 101 classes covered
+    assert labels.shape[0] == n_cls * 4
+    assert sorted(set(labels.tolist())) == list(range(n_cls))
+    for lb in seen_labels:
+        assert len(set(lb.tolist())) == 1
+    # even class ids (51 of 101) predicted correctly, odd ids mapped to 0
+    assert np.isclose(res["accuracy"], 51 / 101)
+    assert np.isclose(res["val_loss"], 0.5)
 
 
 def _make_ucf101(root, classes=("ApplyEyeMakeup", "Archery"), n_frames=4):

@@ -228,6 +228,78 @@ def test_edge_aware_reduces_smoothness(rng):
     assert float(edge["V_loss"]) <= float(plain["V_loss"]) + 1e-9
 
 
+def test_edge_aware_photo_matches_oracle(rng):
+    """needImageGradients photometric weighting vs a direct numpy port of
+    the reference (`flyingChairsWrapFlow_vgg.py:226-276`): elementwise
+    Charbonnier * border mask * per-sample min-max-normalized Sobel
+    gradient magnitude of the target image, summed / numValidPixels."""
+    img1 = rng.rand(2, 20, 24, 3).astype(np.float32)
+    img2 = rng.rand(2, 20, 24, 3).astype(np.float32)
+    flow = np.zeros((2, 20, 24, 2), np.float32)
+    cfg = _loss_cfg(edge_aware_photo=True)
+    ld, _ = loss_interp(jnp.asarray(flow), jnp.asarray(img1),
+                        jnp.asarray(img2), 1.0, cfg)
+
+    b, h, w, c = img1.shape
+    bw = math.ceil(h * 0.1)
+    bmask = np.zeros((h, w), np.float32)
+    bmask[bw : h - bw, bw : w - bw] = 1.0
+
+    # gradient mask of the *inputs* (prev frame)
+    mn = img1.min(axis=(1, 2, 3), keepdims=True)
+    mx = img1.max(axis=(1, 2, 3), keepdims=True)
+    scaled = np.clip(np.floor(255.0 * (img1 - mn) / (mx - mn)), 0, 255)
+    gray = scaled @ np.array([0.2989, 0.587, 0.114], np.float32)  # (b,h,w)
+    kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], np.float32)
+    pad = np.pad(gray, ((0, 0), (1, 1), (1, 1)))
+    gx = np.zeros_like(gray)
+    gy = np.zeros_like(gray)
+    for dy in range(3):
+        for dx in range(3):
+            win = pad[:, dy : dy + h, dx : dx + w]
+            gx += kx[dy, dx] * win
+            gy += kx.T[dy, dx] * win
+    mag = np.sqrt(gx**2 + gy**2)
+    mmn = mag.min(axis=(1, 2), keepdims=True)
+    mmx = mag.max(axis=(1, 2), keepdims=True)
+    gmask = np.clip((mag - mmn) / (mmx - mmn), 0.0, 1.0)
+
+    diff = 255.0 * (img2 - img1)  # zero flow -> recon == img2
+    ele = (diff**2 + 1e-8) ** 0.25 * bmask[None, :, :, None]
+    ele = ele * gmask[..., None]
+    want = ele.sum() / (b * c * bmask.sum())
+    np.testing.assert_allclose(float(ld["Charbonnier_reconstruct"]), want,
+                               rtol=1e-4)
+    # weighting must change (reduce) the unweighted loss
+    ld0, _ = loss_interp(jnp.asarray(flow), jnp.asarray(img1),
+                         jnp.asarray(img2), 1.0, _loss_cfg())
+    assert float(ld["Charbonnier_reconstruct"]) < float(
+        ld0["Charbonnier_reconstruct"])
+
+    # smoothness side (`flyingChairsWrapFlow_vgg.py:293-301`): both terms
+    # weighted by 1-|grad| — closed form with zero flow in the depthwise
+    # variant: ele == (eps^2)^alpha_s everywhere, x/y channels identical
+    ldd, _ = loss_interp(jnp.asarray(flow), jnp.asarray(img1),
+                         jnp.asarray(img2), 1.0,
+                         _loss_cfg(edge_aware_photo=True,
+                                   smoothness="depthwise"))
+    eps_s = (1e-8) ** 0.37
+    want_u = (eps_s * 2.0 * ((1.0 - gmask) * bmask[None]).sum()
+              / (b * c * bmask.sum() / 3.0 * 2.0))
+    np.testing.assert_allclose(float(ldd["U_loss"]), want_u, rtol=1e-4)
+    np.testing.assert_allclose(float(ldd["V_loss"]), want_u, rtol=1e-4)
+
+    # multi-frame volume loss must reject the flag, not silently skip it
+    import pytest as _pytest
+
+    from deepof_tpu.losses import loss_interp_multi
+
+    with _pytest.raises(ValueError, match="edge_aware_photo"):
+        loss_interp_multi(jnp.zeros((1, 20, 24, 4)),
+                          jnp.zeros((1, 20, 24, 9)), 1.0,
+                          _loss_cfg(edge_aware_photo=True))
+
+
 def test_multi_frame_matches_stacked_two_frame(rng):
     """For T=2 the volume loss photometric term must equal the 2-frame one."""
     b, h, w = 1, 12, 16

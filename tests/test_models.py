@@ -19,6 +19,7 @@ from deepof_tpu.models import (
     bilinear_kernel_init,
 )
 
+pytestmark = pytest.mark.slow  # full-model compiles; see pytest.ini
 H, W = 64, 128  # divisible by 64
 
 

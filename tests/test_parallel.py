@@ -3,6 +3,7 @@ CPU mesh (SURVEY.md §4: multi-node behavior without a real cluster)."""
 
 import numpy as np
 import jax
+import pytest
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
@@ -21,6 +22,7 @@ from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
 from deepof_tpu.parallel.spatial import halo_exchange
 from deepof_tpu.train.state import create_train_state, make_optimizer
 from deepof_tpu.train.step import make_train_step
+pytestmark = pytest.mark.slow  # full-model/train-step compiles; see pytest.ini
 
 H, W = 32, 64
 # Spatial CP only activates at high resolution (H >= 128 * spatial shards,

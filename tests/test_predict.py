@@ -11,6 +11,7 @@ cv2 = pytest.importorskip("cv2")
 
 from deepof_tpu.cli import main as cli_main
 from deepof_tpu.io.flo import read_flo
+pytestmark = pytest.mark.slow  # full-model/train-step compiles; see pytest.ini
 
 
 def test_predict_cli_roundtrip(tmp_path):

@@ -28,6 +28,7 @@ from deepof_tpu.train import (
     step_decay_schedule,
 )
 from deepof_tpu.train.state import make_optimizer
+pytestmark = pytest.mark.slow  # full-model/train-step compiles; see pytest.ini
 
 H, W = 64, 64
 
