@@ -75,19 +75,26 @@ def smoothness_mask_y(h: int, w: int) -> jnp.ndarray:
     return jnp.ones((h, w)).at[-1, :].set(0.0)
 
 
-def _edge_aware_masks(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Sobel-based smoothness down-weighting near image edges.
-
-    Reference `version1/model/warpflow.py:93-117`: per-sample min-max
-    normalize to [0, 255], grayscale, Sobel x/y, normalize by global max
-    magnitude, mask = 1 - |grad|. Returns (mask_x, mask_y), each (B,H,W,1).
-    """
+def _normalized_sobel(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Shared edge-mask preprocessing: per-sample min-max normalize to
+    integer [0, 255], grayscale, Sobel x/y (`version1/model/warpflow.py:
+    93-108`, `flyingChairsWrapFlow_vgg.py:226-246`). Returns raw
+    (gx, gy), each (B,H,W,1)."""
     mn = jnp.min(inputs, axis=(1, 2, 3), keepdims=True)
     mx = jnp.max(inputs, axis=(1, 2, 3), keepdims=True)
     img = 255.0 * (inputs - mn) / jnp.maximum(mx - mn, 1e-12)
     img = jnp.clip(jnp.floor(img), 0.0, 255.0)
-    gray = to_grayscale(img)
-    gx, gy = sobel_gradients(gray)
+    return sobel_gradients(to_grayscale(img))
+
+
+def _edge_aware_masks(inputs: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sobel-based smoothness down-weighting near image edges.
+
+    Reference `version1/model/warpflow.py:93-117`: normalized Sobel x/y,
+    each normalized by its global max magnitude, mask = 1 - |grad|.
+    Returns (mask_x, mask_y), each (B,H,W,1).
+    """
+    gx, gy = _normalized_sobel(inputs)
     gx = gx / jnp.maximum(jnp.max(jnp.abs(gx)), 1e-12)
     gy = gy / jnp.maximum(jnp.max(jnp.abs(gy)), 1e-12)
     return 1.0 - jnp.abs(gx), 1.0 - jnp.abs(gy)
@@ -103,12 +110,7 @@ def _photo_gradient_mask(inputs: jnp.ndarray) -> jnp.ndarray:
     *emphasizes* structured pixels in the Charbonnier sum. Returns
     (B, H, W, 1).
     """
-    mn = jnp.min(inputs, axis=(1, 2, 3), keepdims=True)
-    mx = jnp.max(inputs, axis=(1, 2, 3), keepdims=True)
-    img = 255.0 * (inputs - mn) / jnp.maximum(mx - mn, 1e-12)
-    img = jnp.clip(jnp.floor(img), 0.0, 255.0)
-    gray = to_grayscale(img)
-    gx, gy = sobel_gradients(gray)
+    gx, gy = _normalized_sobel(inputs)
     mag = jnp.sqrt(jnp.square(gx) + jnp.square(gy))
     mmn = jnp.min(mag, axis=(1, 2, 3), keepdims=True)
     mmx = jnp.max(mag, axis=(1, 2, 3), keepdims=True)
@@ -175,6 +177,11 @@ def loss_interp(
     # needImageGradients (`flyingChairsWrapFlow_vgg.py:226-301`): the same
     # per-sample gradient-magnitude mask weights the photometric term by
     # |grad| and BOTH smoothness terms by 1-|grad| (edges may move freely).
+    if cfg.edge_aware_photo and cfg.photometric != "charbonnier":
+        raise ValueError(
+            "loss.edge_aware_photo pairs only with photometric='charbonnier' "
+            f"(got {cfg.photometric!r}); the census branch would silently "
+            "skip the photometric weighting")
     gmask = _photo_gradient_mask(inputs) if cfg.edge_aware_photo else None
 
     bmask = border_mask(h, w, cfg.border_ratio)  # (h, w)

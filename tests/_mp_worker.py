@@ -1,0 +1,169 @@
+"""Worker process for test_multiprocess.py: one of N JAX CPU processes.
+
+Launched with PYTHONPATH cleared (skips the container's sitecustomize);
+forces 4 virtual CPU devices, joins the distributed runtime, and runs the
+multi-host data-path plumbing (SURVEY.md §5.8): `local_batch_rows` row
+slicing -> `put_global` assembly -> sharded train step, the stacked
+[K, B, ...] `steps_per_call` layout, and the allgathered eval. Writes its
+metrics as JSON for the parent test to compare against a single-process
+run of the identical batches.
+
+`make_setup()` is imported by test_multiprocess.py for its single-process
+reference run — the equality asserts are only meaningful if both sides
+build the identical config/model/optimizer/initial state.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+H, W, BATCH = 16, 32, 8
+
+
+def make_setup():
+    """(cfg, ds, model, new_state_fn) shared by worker and reference."""
+    import jax.numpy as jnp
+    import optax
+
+    from deepof_tpu.core.config import (
+        DataConfig,
+        ExperimentConfig,
+        LossConfig,
+        MeshConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.train.state import create_train_state
+
+    cfg = ExperimentConfig(
+        name="mp",
+        model="flownet_s",
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=1e-4),
+        data=DataConfig(dataset="synthetic", image_size=(H, W),
+                        gt_size=(H, W), batch_size=BATCH),
+        mesh=MeshConfig(),  # pure data-parallel: data axis spans all hosts
+        train=TrainConfig(seed=0),
+    )
+    ds = SyntheticData(cfg.data)
+    model = build_model("flownet_s")
+    # SGD, not Adam: the test asserts cross-runtime loss EQUALITY, and
+    # Adam's eps-scaled normalization amplifies the tiny collective
+    # reassociation differences between the distributed and single-
+    # process runtimes into O(lr) param drift; SGD is linear in grad
+    tx = optax.sgd(cfg.optim.learning_rate)
+
+    def new_state():
+        return create_train_state(model, jnp.zeros((BATCH, H, W, 6)), tx,
+                                  seed=0)
+
+    return cfg, ds, model, new_state
+
+
+def main() -> None:
+    addr, nproc, pid, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+    from deepof_tpu.core.hostmesh import force_cpu_devices
+
+    force_cpu_devices(4)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from deepof_tpu.parallel.mesh import (
+        batch_sharding,
+        build_mesh,
+        local_batch_rows,
+        process_seed,
+        put_global,
+        put_global_from_full,
+        stacked_batch_sharding,
+    )
+    from deepof_tpu.train.step import make_eval_fn, make_train_step
+
+    cfg, ds, model, new_state = make_setup()
+    mesh = build_mesh(cfg.mesh)
+    state = new_state()
+    step = make_train_step(model, cfg, ds.mean, mesh)
+
+    n_local, rows = local_batch_rows(mesh, BATCH)
+    results = {
+        "rows": rows,
+        "n_local": n_local,
+        "process_seed": process_seed(mesh, 123),
+    }
+
+    # 2 train steps: each process loads ONLY its own rows of the
+    # (deterministic) global batch; put_global assembles without any host
+    # holding the full batch.
+    for k in range(2):
+        gb = ds.sample_train(BATCH, iteration=k)
+        lb = {key: np.asarray(v)[rows] for key, v in gb.items()}
+        b = put_global(lb, batch_sharding(mesh))
+        state, m = step(state, b)
+        results[f"step{k}_total"] = float(jax.device_get(m["total"]))
+        results[f"step{k}_gradnorm"] = float(jax.device_get(m["grad_norm"]))
+        flat, _ = jax.flatten_util.ravel_pytree(state.params)
+        results[f"step{k}_param_checksum"] = float(
+            jax.device_get(jnp.abs(flat).sum()))
+
+    # steps_per_call=2: stacked [K, local_B, ...] leaves under
+    # P(None, "data") via make_array_from_process_local_data (the
+    # non-leading sharded axis layout).
+    kcfg = cfg.replace(train=dataclasses.replace(cfg.train, steps_per_call=2))
+    kstate = new_state()
+    kstep = make_train_step(model, kcfg, ds.mean, mesh)
+    g0 = ds.sample_train(BATCH, iteration=0)
+    g1 = ds.sample_train(BATCH, iteration=1)
+    stacked = {key: np.stack([np.asarray(g0[key])[rows],
+                              np.asarray(g1[key])[rows]]) for key in g0}
+    kb = put_global(stacked, stacked_batch_sharding(mesh))
+    kstate, km = kstep(kstate, kb)
+    results["scan_totals"] = np.asarray(jax.device_get(km["total"])).tolist()
+
+    # allgathered eval: every host loads the same full val batch,
+    # contributes its rows, and gathers the outputs (train/loop.py's
+    # multi-host eval path).
+    from jax.experimental import multihost_utils
+
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+    vb = ds.sample_val(BATCH, 0)
+    gvb = put_global_from_full(vb, mesh, batch_sharding(mesh))
+    # assembly diagnostics: the global array each host sees must be the
+    # full val batch, byte-identical to the host-local copy
+    gsrc = np.asarray(multihost_utils.process_allgather(gvb["source"],
+                                                        tiled=True))
+    results["val_src_assembled_ok"] = bool(
+        np.array_equal(gsrc, np.asarray(vb["source"])))
+    # eval with the UNTRAINED params isolates batch assembly from any
+    # cross-runtime optimizer drift
+    out0 = eval_fn(new_state().params, gvb)
+    results["eval_init_total"] = float(np.asarray(
+        multihost_utils.process_allgather(out0["total"], tiled=True)).ravel()[0])
+    out = eval_fn(state.params, gvb)
+    gathered = {k2: np.asarray(multihost_utils.process_allgather(v, tiled=True))
+                for k2, v in out.items()}
+    results["eval_total"] = float(gathered["total"].ravel()[0])
+    results["eval_flow_shape"] = list(gathered["flow"].shape)
+    results["eval_flow_sum"] = float(np.abs(gathered["flow"]).sum())
+
+    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+        json.dump(results, f)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""True multi-process DCN-path test (SURVEY.md §5.8).
+
+Spawns 2 subprocess JAX CPU processes (4 virtual devices each) joined via
+`jax.distributed.initialize`, runs the multi-host data plumbing
+(`local_batch_rows` / `put_global` / stacked steps_per_call /
+allgathered eval) inside them, and asserts loss equality with a
+single-process run of the identical batches on this process's own
+8-device mesh. The experiment setup is shared with the worker
+(`_mp_worker.make_setup`) so both sides are guaranteed identical.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _mp_worker  # noqa: E402
+
+from deepof_tpu.parallel.mesh import batch_sharding, build_mesh  # noqa: E402
+from deepof_tpu.train.step import make_eval_fn, make_train_step  # noqa: E402
+
+pytestmark = pytest.mark.slow  # 2 extra processes, each compiling 3 steps
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_reference():
+    """The same batches/model/optimizer on this process's 8-device mesh."""
+    cfg, ds, model, new_state = _mp_worker.make_setup()
+    batch = _mp_worker.BATCH
+    mesh = build_mesh(cfg.mesh)
+    state = new_state()
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    totals = []
+    for k in range(2):
+        b = jax.device_put(ds.sample_train(batch, iteration=k),
+                           batch_sharding(mesh))
+        state, m = step(state, b)
+        totals.append(float(jax.device_get(m["total"])))
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+    vb = jax.device_put(ds.sample_val(batch, 0), batch_sharding(mesh))
+    eval_init = float(jax.device_get(eval_fn(new_state().params, vb)["total"]))
+    out = eval_fn(state.params, vb)
+    return totals, float(jax.device_get(out["total"])), eval_init
+
+
+def test_two_process_dcn_path(tmp_path):
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    # a clean interpreter: no sitecustomize (axon backend), no inherited
+    # XLA flags from this pytest process (its 8-device count would double
+    # the workers' own 4-device setting)
+    env.pop("PYTHONPATH", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "_mp_worker.py"),
+             addr, "2", str(pid), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    res = []
+    for pid in range(2):
+        with open(tmp_path / f"proc{pid}.json") as f:
+            res.append(json.load(f))
+
+    # each process owns a disjoint contiguous half of the global batch
+    assert res[0]["n_local"] == res[1]["n_local"] == 4
+    assert sorted(res[0]["rows"] + res[1]["rows"]) == list(range(8))
+    assert not set(res[0]["rows"]) & set(res[1]["rows"])
+    # distinct data coords -> decorrelated host sampling streams
+    assert res[0]["process_seed"] != res[1]["process_seed"]
+
+    # metrics are replicated: both processes observe identical values
+    for key in ("step0_total", "step1_total", "step0_gradnorm",
+                "step1_gradnorm", "step0_param_checksum",
+                "step1_param_checksum", "scan_totals", "eval_total",
+                "eval_flow_sum", "eval_flow_shape"):
+        assert res[0][key] == res[1][key], key
+
+    # and they equal the single-process run of the same batches.
+    # step0 evaluates at IDENTICAL params (pure reassociation bound);
+    # step1 already includes one step of curvature-amplified drift
+    ref_totals, ref_eval, ref_eval_init = _single_process_reference()
+    np.testing.assert_allclose(res[0]["step0_total"], ref_totals[0], rtol=1e-5)
+    np.testing.assert_allclose(res[0]["step1_total"], ref_totals[1], rtol=1e-4)
+    # the scanned K=2 path consumed the same two batches
+    np.testing.assert_allclose(res[0]["scan_totals"], ref_totals, rtol=1e-4)
+    # the assembled global val batch is byte-identical to the full copy
+    assert res[0]["val_src_assembled_ok"]
+    np.testing.assert_allclose(res[0]["eval_init_total"], ref_eval_init,
+                               rtol=1e-5)
+    # the 2-step-trained eval compares across DIFFERENT collective
+    # topologies (hierarchical 2-process all-reduce vs single-runtime):
+    # the reduction-reassociation noise is amplified by the loss curvature
+    # each SGD step (measured ~100x/step at lr=1e-3), so exact equality is
+    # unattainable by construction; 1e-3 bounds the chaos at lr=1e-4 with
+    # an order of margin. The exact-equality claims are the init-params
+    # eval and per-step losses above.
+    np.testing.assert_allclose(res[0]["eval_total"], ref_eval, rtol=1e-3)
+    # allgathered eval output covers the FULL global val batch on each host
+    assert res[0]["eval_flow_shape"][0] == 8
