@@ -37,6 +37,7 @@ class FlowNetCS(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 64
 
     @nn.compact
     def __call__(self, pair: jnp.ndarray) -> list[jnp.ndarray]:

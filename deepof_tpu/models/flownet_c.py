@@ -27,6 +27,7 @@ class FlowNetC(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 64  # conv1..conv6 stride-2 chain (same tail as FlowNet-S)
 
     @nn.compact
     def __call__(self, pair: jnp.ndarray) -> list[jnp.ndarray]:

@@ -27,6 +27,7 @@ class FlowNetS(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 64  # six stride-2 stages; spatial-CP gradient-safety bound
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:

@@ -190,6 +190,7 @@ class InceptionV3Flow(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 32  # five stride-2 stages; spatial-CP gradient-safety bound
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:

@@ -80,6 +80,7 @@ class UCF101Spatial(nn.Module):
     dtype: Any = jnp.float32
 
     classifier_only = True  # step dispatch: logits, no flow pyramid
+    max_downsample = 32
 
     @nn.compact
     def __call__(self, frame: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -95,6 +96,7 @@ class STSingle(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = VGG_SCALES
+    max_downsample = 32
     has_action_head = True  # step dispatch: returns (flows, logits)
 
     @nn.compact
@@ -123,6 +125,7 @@ class STBaseline(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOWNET_SCALES
+    max_downsample = 64
     has_action_head = True  # step dispatch: returns (flows, logits)
 
     @nn.compact

@@ -46,6 +46,7 @@ class VGG16Flow(nn.Module):
     dtype: Any = jnp.float32
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 32  # five maxpools; spatial-CP gradient-safety bound
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:

@@ -70,33 +70,56 @@ def batch_spec(mesh: Mesh) -> P:
     return P("data")
 
 
-# Spatial CP contract: every pyramid level must keep >= 2 rows per spatial
-# shard. The deepest encoders downsample H by 2^6 = 64; letting a level
-# collapse below one row per shard trips a degenerate GSPMD halo backward
-# that mis-scales those layers' gradients (verified empirically: conv6/pr6
-# grads come back x4 when H/64 < spatial). 128 = 64 * 2 rows.
-MIN_H_PER_SPATIAL_SHARD = 128
+# Spatial CP gradient-safety contract: every pyramid level must keep
+# >= MIN_ROWS_PER_SHARD rows per spatial shard. Root cause (minimal repro:
+# tools/halo_grad_repro.py): when a stride-2 SAME conv chain reaches a
+# level with FEWER than 2 rows per shard, XLA's SPMD partitioner emits a
+# degenerate backward halo exchange that mis-scales the input cotangent —
+# every upstream conv's gradient comes back multiplied by a constant (x4
+# at spatial=2 with a 1-row/shard level; x2 in some sub-row collapse
+# regimes; exact factor depends on GSPMD's level-by-level partitioning
+# choices) while downstream layers stay correct. At >= 2 rows per shard
+# the backward is exact in every configuration tested (spatial 2 and 4,
+# depths 2-5). The guard is therefore derived per model from its real
+# downsample factor, not a blanket constant.
+MIN_ROWS_PER_SHARD = 2
 
 
-def constrain_batch(batch: dict, mesh: Mesh | None = None) -> dict:
+def min_spatial_height(max_downsample: int, spatial: int) -> int:
+    """Smallest input H for which spatial CP is gradient-safe for a model
+    whose deepest level is H / max_downsample: that level must keep
+    MIN_ROWS_PER_SHARD rows on each of `spatial` shards."""
+    return MIN_ROWS_PER_SHARD * max_downsample * spatial
+
+
+def constrain_batch(batch: dict, mesh: Mesh | None = None,
+                    max_downsample: int = 64) -> dict:
     """Apply the spatial-CP sharding constraint to every image-like leaf
     (rank >= 4: (B, H, W, C) images, volumes, GT flows) of a batch dict.
 
     With a mesh whose "spatial" axis is populated, GSPMD reshards H over it
     and spatially partitions all downstream convolutions (halo exchanges
     inserted by the compiler). No-op otherwise, when H does not divide, or
-    when H is too small for the contract above (spatial CP is a
-    high-resolution feature; at low res it would only lose to pure DP).
+    when H is below `min_spatial_height` for the model's downsample factor
+    (the gradient-safety fence above — and at low res spatial CP would
+    only lose to pure DP anyway).
     """
     mesh = mesh if mesh is not None else current_mesh()
     if mesh is None or mesh.shape.get("spatial", 1) <= 1:
         return batch
     spatial = mesh.shape["spatial"]
     sharding = NamedSharding(mesh, P(("data",), "spatial"))
+    min_h = min_spatial_height(max_downsample, spatial)
 
     def put(v):
-        if (getattr(v, "ndim", 0) >= 4 and v.shape[1] % spatial == 0
-                and v.shape[1] >= MIN_H_PER_SPATIAL_SHARD * spatial):
+        # H must divide max_downsample * spatial, not merely spatial:
+        # otherwise a deep level can end up with a row count that does not
+        # divide the shard count (e.g. H=520, spatial=4, downsample 64 ->
+        # 9 rows over 4 shards), whose padded last shard is exactly the
+        # <2-rows-per-shard degenerate regime again.
+        if (getattr(v, "ndim", 0) >= 4
+                and v.shape[1] % (max_downsample * spatial) == 0
+                and v.shape[1] >= min_h):
             return lax.with_sharding_constraint(v, sharding)
         return v
 

@@ -119,14 +119,17 @@ class Trainer:
 
         spatial = self.mesh.shape.get("spatial", 1)
         if spatial > 1:
-            from ..parallel.spatial import MIN_H_PER_SPATIAL_SHARD
+            from ..parallel.spatial import min_spatial_height
 
             h = (cfg.data.crop_size or cfg.data.image_size)[0]
-            if h < MIN_H_PER_SPATIAL_SHARD * spatial:
+            min_h = min_spatial_height(
+                getattr(self.model, "max_downsample", 64), spatial)
+            if h < min_h:
                 self.logger.log(
                     "warn", 0,
-                    message=f"spatial CP inactive: H={h} < "
-                            f"{MIN_H_PER_SPATIAL_SHARD}*spatial({spatial}); "
+                    message=f"spatial CP inactive: H={h} < {min_h} "
+                            f"(gradient-safety bound for "
+                            f"{cfg.model} at spatial={spatial}); "
                             "those devices only replicate work")
 
         smooth_border = cfg.model in ("st_single", "st_baseline")

@@ -62,8 +62,10 @@ def model_losses(
     # Spatial context parallelism: shard H over the "spatial" mesh axis (if
     # populated) so GSPMD partitions the convs with compiler-inserted halo
     # exchanges (SURVEY.md §5.7). Reads the mesh from the enclosing
-    # `mesh_context` set by the step builders.
-    batch = constrain_batch(batch)
+    # `mesh_context` set by the step builders. The model's downsample
+    # factor derives the gradient-safety fence (parallel/spatial.py).
+    batch = constrain_batch(
+        batch, max_downsample=getattr(model, "max_downsample", 64))
 
     def fwd(x, **kw):
         def inner(xx):

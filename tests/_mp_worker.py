@@ -82,6 +82,7 @@ def main() -> None:
 
     import numpy as np
     import jax.numpy as jnp
+    from jax import flatten_util
 
     from deepof_tpu.parallel.mesh import (
         batch_sharding,
@@ -116,7 +117,7 @@ def main() -> None:
         state, m = step(state, b)
         results[f"step{k}_total"] = float(jax.device_get(m["total"]))
         results[f"step{k}_gradnorm"] = float(jax.device_get(m["grad_norm"]))
-        flat, _ = jax.flatten_util.ravel_pytree(state.params)
+        flat, _ = flatten_util.ravel_pytree(state.params)
         results[f"step{k}_param_checksum"] = float(
             jax.device_get(jnp.abs(flat).sum()))
 

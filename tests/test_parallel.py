@@ -84,6 +84,78 @@ def test_spatial_cp_matches_data_parallel():
     assert np.isclose(gn_dp, gn_sp, rtol=1e-4)
 
 
+def test_spatial_grad_exact_at_derived_bound():
+    """Gradient correctness AT the fence: H == min_spatial_height (every
+    level keeps exactly MIN_ROWS_PER_SHARD rows per shard) gives sharded
+    grads equal to replicated grads. The unsafe side one octave below is
+    pinned by tools/halo_grad_repro.py (x4 upstream grads) and fenced off
+    by constrain_batch (test below)."""
+    from flax import linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepof_tpu.parallel.spatial import min_spatial_height
+
+    mesh = build_mesh(MeshConfig(spatial=2))
+    spatial, n_down = 2, 5  # downsample factor 32
+    h = min_spatial_height(2 ** n_down, spatial)  # == 128
+    assert h == 128
+
+    class Stack(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(n_down):
+                x = nn.elu(nn.Conv(4, (3, 3), strides=(2, 2),
+                                   padding="SAME", name=f"c{i}")(x))
+            return nn.Conv(2, (3, 3), padding="SAME", name="head")(x)
+
+    model = Stack()
+    x = jnp.asarray(np.random.RandomState(0).rand(4, h, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p, xx, shard):
+        if shard:
+            xx = jax.lax.with_sharding_constraint(
+                xx, NamedSharding(mesh, P(("data",), "spatial")))
+        return (model.apply({"params": p}, xx) ** 2).sum()
+
+    g_repl = jax.device_get(
+        jax.jit(jax.grad(lambda p, xx: loss(p, xx, False)))(params, x))
+    g_shard = jax.device_get(
+        jax.jit(jax.grad(lambda p, xx: loss(p, xx, True)))(params, x))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        g_repl, g_shard)
+
+
+def test_spatial_fence_below_bound():
+    """constrain_batch must refuse to shard below the derived bound (the
+    degenerate-halo regime) and apply the constraint at or above it."""
+    from jax.sharding import NamedSharding
+
+    from deepof_tpu.parallel.spatial import constrain_batch, min_spatial_height
+
+    mesh = build_mesh(MeshConfig(spatial=2))
+    assert min_spatial_height(32, 2) == 128
+    below = jnp.zeros((4, 64, 32, 3))   # divides spatial, but < bound
+    at = jnp.zeros((4, 128, 32, 3))
+    out = jax.jit(lambda b: constrain_batch(b, mesh=mesh, max_downsample=32))(
+        {"below": below, "at": at})
+    spatial_sh = NamedSharding(mesh, P(("data",), "spatial"))
+    assert not out["below"].sharding.is_equivalent_to(spatial_sh, 4)
+    assert out["at"].sharding.is_equivalent_to(spatial_sh, 4)
+    # a deeper model (factor 64) must refuse H=128 too
+    out64 = jax.jit(lambda b: constrain_batch(b, mesh=mesh,
+                                              max_downsample=64))({"at": at})
+    assert not out64["at"].sharding.is_equivalent_to(spatial_sh, 4)
+    # above the bound but NOT divisible by downsample*spatial (160 % 64):
+    # the deepest level would have a row count that does not divide the
+    # shard count — the padded-shard degenerate regime; must refuse
+    odd = jnp.zeros((4, 160, 32, 3))
+    out_odd = jax.jit(lambda b: constrain_batch(b, mesh=mesh,
+                                                max_downsample=32))({"x": odd})
+    assert not out_odd["x"].sharding.is_equivalent_to(spatial_sh, 4)
+
+
 def test_time_axis_pair_parallel_volume():
     """Sintel-style T-frame volume step with the folded pair axis sharded
     over the "time" mesh axis matches the unsharded result."""

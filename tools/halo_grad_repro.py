@@ -1,0 +1,100 @@
+"""Minimal repro: GSPMD spatial-sharding gradient mis-scaling.
+
+Evidence behind `parallel/spatial.py`'s MIN_ROWS_PER_SHARD=2 fence. A
+stride-2 SAME-padded conv chain is differentiated twice — input batch
+replicated vs. H sharded over the "spatial" mesh axis — and per-layer
+kernel-gradient ratios are printed.
+
+Findings on the 8-device CPU mesh (jax 0.9 era; mechanism is the SPMD
+partitioner, not the backend):
+
+  - If every level keeps >= 2 rows per spatial shard, sharded and
+    replicated gradients agree to float tolerance in every configuration
+    tested (spatial 2 and 4, depths 2-5).
+  - Once the chain reaches a level with exactly 1 row per shard
+    (H_level == spatial), the backward halo exchange of that level's conv
+    mis-scales the input cotangent: EVERY upstream conv's gradient comes
+    back x4 (spatial=2) while all downstream layers stay exact. With
+    spatial=4 the same 1-row/shard collapse happens to come back clean,
+    but a deeper sub-row collapse (H_level < spatial) shows x2 — the
+    factor depends on GSPMD's per-level partitioning choices, so the only
+    robust contract is the 2-rows-per-shard floor.
+  - Very small inputs (e.g. H=8, 2 levels) escape the bug because GSPMD
+    replicates the tiny levels instead of partitioning them.
+
+Run: python tools/halo_grad_repro.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+from flax import linen as nn  # noqa: E402
+
+
+def make_stack(n_down: int):
+    class Stack(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            for i in range(n_down):
+                x = nn.elu(nn.Conv(4, (3, 3), strides=(2, 2), padding="SAME",
+                                   name=f"c{i}")(x))
+            return nn.Conv(2, (3, 3), padding="SAME", name="head")(x)
+
+    return Stack()
+
+
+def probe(spatial: int, h: int, n_down: int, w: int = 32) -> None:
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8 // spatial, spatial),
+                ("data", "spatial"))
+    model = make_stack(n_down)
+    x = jnp.asarray(np.random.RandomState(0).rand(8 // spatial, h, w, 3),
+                    jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+
+    def loss(p, xx, shard):
+        if shard:
+            xx = jax.lax.with_sharding_constraint(
+                xx, NamedSharding(mesh, P(("data",), "spatial")))
+        return (model.apply({"params": p}, xx) ** 2).sum()
+
+    gr = jax.device_get(
+        jax.jit(jax.grad(lambda p, xx: loss(p, xx, False)))(params, x))
+    gs = jax.device_get(
+        jax.jit(jax.grad(lambda p, xx: loss(p, xx, True)))(params, x))
+    coarsest = h >> n_down
+    print(f"spatial={spatial} H={h} depth={n_down} coarsestH={coarsest} "
+          f"({coarsest / spatial:.1f} rows/shard):")
+    for name in sorted(gr):
+        r = np.asarray(gr[name]["kernel"]).ravel()
+        s = np.asarray(gs[name]["kernel"]).ravel()
+        m = np.abs(r) > 1e-6 * np.abs(r).max()
+        ratio = float(np.median(np.abs(s[m] / r[m])))
+        err = float(np.abs(s - r).max() / np.abs(r).max())
+        flag = "  <-- MISMATCH" if err > 1e-3 else ""
+        print(f"  {name:6s} median|g_sharded/g_repl|={ratio:8.4f} "
+              f"relerr={err:.2e}{flag}")
+
+
+if __name__ == "__main__":
+    # broken: a 1-row/shard level at spatial=2 -> every upstream grad x4
+    probe(2, 64, 5)
+    probe(2, 32, 4)
+    # clean: 2 rows/shard at the coarsest level
+    probe(2, 128, 5)
+    probe(4, 64, 3)
+    # partitioner-choice-dependent: 1-row/shard clean at spatial=4, but a
+    # sub-row collapse shows x2
+    probe(4, 32, 3)
+    probe(4, 32, 4)
