@@ -138,6 +138,27 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     return cfg, mesh, ds, model, state, step, b
 
 
+# Nominal dense bf16 peak of the chip this container tunnels to (v5e:
+# 197 TFLOP/s). Used only to turn measured model-FLOP throughput into an
+# absolute MFU figure; `mfu_vs_matmul` (vs the concurrently measured raw
+# matmul rate) is the tunnel-condition-independent one.
+NOMINAL_BF16_TFLOPS = 197.0
+
+
+def step_flops(step, state, b) -> float | None:
+    """XLA's own FLOPs estimate for one compiled train step
+    (`jit(...).lower(...).compile().cost_analysis()`); None if the backend
+    does not report it."""
+    try:
+        ca = step.lower(state, b).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 - cost model is best-effort
+        return None
+
+
 def bench(model_name: str = "inception_v3", batch: int = 16,
           image_size=(320, 448), steps: int = 20, warmup: int = 3,
           windows: int = 4) -> dict:
@@ -168,9 +189,26 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     pairs_per_sec = steps * batch / dt
     per_chip = pairs_per_sec / n_chips
     assert np.isfinite(total)
-    return {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
-            "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt,
-            **calibrate()}
+    res = {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
+           "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt,
+           **calibrate()}
+    # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
+    # nominal chip peak and the concurrently measured matmul rate (the
+    # latter cancels tunnel-condition swings — DESIGN.md).
+    flops = step_flops(step, state, b)
+    if flops:
+        # cost_analysis reports PER-DEVICE (post-SPMD-partition) FLOPs
+        # (verified: an 8-way-sharded einsum reports 1/8 of global), so
+        # flops * steps/sec is already the per-chip rate — no /n_chips.
+        model_tflops = flops * res["steps_per_sec"] / 1e12
+        res.update(
+            flops_per_step=flops,
+            model_tflops=round(model_tflops, 2),
+            mfu_nominal=round(model_tflops / NOMINAL_BF16_TFLOPS, 4),
+            mfu_vs_matmul=round(model_tflops / max(res["matmul_tflops"], 1e-9),
+                                4),
+        )
+    return res
 
 
 def main(deadline_s: float = 1500.0) -> None:
@@ -194,9 +232,10 @@ def main(deadline_s: float = 1500.0) -> None:
             vs = res["pairs_per_sec_per_chip"] / base
     except Exception:  # noqa: BLE001 - missing/corrupt baseline: still emit
         vs = 1.0
-    emit(res["pairs_per_sec_per_chip"], vs,
-         matmul_tflops=res["matmul_tflops"], rtt_ms=res["rtt_ms"],
-         batch=res["batch"])
+    extra = {k: res[k] for k in ("matmul_tflops", "rtt_ms", "batch",
+                                 "model_tflops", "mfu_nominal",
+                                 "mfu_vs_matmul") if k in res}
+    emit(res["pairs_per_sec_per_chip"], vs, **extra)
     os._exit(0)
 
 

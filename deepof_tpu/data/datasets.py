@@ -365,15 +365,21 @@ class SyntheticData:
     mean = (0.0, 0.0, 0.0)
 
     def __init__(self, cfg: DataConfig, num_train: int = 64, num_val: int = 16,
-                 max_shift: float = 4.0):
+                 max_shift: float = 4.0, feature_scale: int = 8):
         self.cfg = cfg
         self.num_train, self.num_val = num_train, num_val
         self._max_shift = max_shift
+        # pixels per random-noise feature: the photometric attraction basin
+        # around the true flow is ~ a quarter feature wavelength, so
+        # feature_scale must comfortably exceed max_shift for the
+        # unsupervised objective to be optimizable from a zero-flow init
+        self._feature_scale = feature_scale
 
     def _sample(self, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         rng = np.random.RandomState(seed)
         h, w = self.cfg.image_size
-        base = rng.rand(h // 8 + 2, w // 8 + 2, 3).astype(np.float32) * 255.0
+        fs = self._feature_scale
+        base = rng.rand(h // fs + 2, w // fs + 2, 3).astype(np.float32) * 255.0
         img = cv2.resize(base, (w + 16, h + 16), interpolation=cv2.INTER_CUBIC)
         u, v = rng.randint(-self._max_shift, self._max_shift + 1, 2)
         src = img[8 : 8 + h, 8 : 8 + w]

@@ -122,15 +122,19 @@ class Trainer:
             from ..parallel.spatial import min_spatial_height
 
             h = (cfg.data.crop_size or cfg.data.image_size)[0]
-            min_h = min_spatial_height(
-                getattr(self.model, "max_downsample", 64), spatial)
-            if h < min_h:
+            down = getattr(self.model, "max_downsample", 64)
+            min_h = min_spatial_height(down, spatial)
+            # mirror constrain_batch's activation condition exactly
+            # (parallel/spatial.py): below the gradient-safety bound OR not
+            # divisible down to the deepest level -> the constraint no-ops
+            if h < min_h or h % (down * spatial):
                 self.logger.log(
                     "warn", 0,
-                    message=f"spatial CP inactive: H={h} < {min_h} "
-                            f"(gradient-safety bound for "
-                            f"{cfg.model} at spatial={spatial}); "
-                            "those devices only replicate work")
+                    message=f"spatial CP inactive: H={h} fails the "
+                            f"gradient-safety gate (need H >= {min_h} and "
+                            f"H % {down * spatial} == 0 for {cfg.model} at "
+                            f"spatial={spatial}); those devices only "
+                            "replicate work")
 
         smooth_border = cfg.model in ("st_single", "st_baseline")
         self.train_step = make_train_step(self.model, cfg, self.dataset.mean,

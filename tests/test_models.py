@@ -134,6 +134,69 @@ def test_dropout_only_in_train_mode():
     assert np.any(np.asarray(c) != np.asarray(d))
 
 
+def _conv(k, cin, cout):
+    """slim conv2d / conv2d_transpose param count: k*k*cin*cout + bias."""
+    return k * k * cin * cout + cout
+
+
+def test_flownet_s_param_parity():
+    """Architecture checksum against the reference, layer by layer — the
+    param-count convention of `flyingChairsTrain.py:106-118`. The expected
+    total is computed analytically from the layer table transcribed from
+    `flyingChairsWrapFlow.py:31-40` (encoder) and `:62-118` (decoder:
+    upconv_k and pr_k consume the concat(skip, upconv, up_pr) feature,
+    concat widths 1026/770/386/194/98)."""
+    encoder = [(7, 6, 64), (5, 64, 128), (5, 128, 256), (3, 256, 256),
+               (3, 256, 512), (3, 512, 512), (3, 512, 512), (3, 512, 512),
+               (3, 512, 1024), (3, 1024, 1024)]
+    want = sum(_conv(k, i, o) for k, i, o in encoder)
+    feat_in, skips = 1024, [512, 512, 256, 128, 64]
+    upconvs = [512, 256, 128, 64, 32]
+    for skip, up in zip(skips, upconvs):
+        want += _conv(3, feat_in, 2)       # pr_k
+        want += _conv(4, feat_in, up)      # upconv_k (4x4, stride 2)
+        want += _conv(4, 2, 2)             # up_pr_k
+        feat_in = skip + up + 2            # concat(skip, upconv, up_pr)
+    want += _conv(3, feat_in, 2)           # pr1 on concat1 (98 ch)
+
+    model = FlowNetS()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 6)))
+    assert count_params(variables["params"]) == want
+
+
+def test_vgg16_flow_param_parity():
+    """Same checksum for the VGG16 flow net (`flyingChairsWrapFlow.py:
+    653-739`): 13-conv trunk, 5 heads, decoder widths 256/128/64/32,
+    concat widths 770/386/194/98."""
+    want = 0
+    cin = 6
+    for cout, n in [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]:
+        for _ in range(n):
+            want += _conv(3, cin, cout)
+            cin = cout
+    feat_in, skips = 512, [512, 256, 128, 64]
+    upconvs = [256, 128, 64, 32]
+    for skip, up in zip(skips, upconvs):
+        want += _conv(3, feat_in, 2) + _conv(4, feat_in, up) + _conv(4, 2, 2)
+        feat_in = skip + up + 2
+    want += _conv(3, feat_in, 2)
+
+    model = VGG16Flow()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 6)))
+    assert count_params(variables["params"]) == want
+
+
+def test_inception_v3_flow_param_count_pinned():
+    """Inception-v3 flow regression checksum: the architecture is pinned
+    structurally by test_inception_tap_channels; the total param count
+    (the reference's "%4.2fM" printout convention) is pinned here so any
+    accidental layer change shows up as a count change. 44.55M with the
+    6-channel pair input."""
+    model = InceptionV3Flow()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 6)))
+    assert count_params(variables["params"]) == 44_553_722
+
+
 def test_bilinear_init_upsamples():
     """A bilinear-initialized 4x4/s2 ConvTranspose must upsample a constant
     image to (nearly) the same constant."""

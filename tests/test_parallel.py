@@ -25,8 +25,9 @@ from deepof_tpu.train.step import make_train_step
 pytestmark = pytest.mark.slow  # full-model/train-step compiles; see pytest.ini
 
 H, W = 32, 64
-# Spatial CP only activates at high resolution (H >= 128 * spatial shards,
-# so every pyramid level keeps >= 2 rows per shard — parallel/spatial.py).
+# Spatial CP only activates at high resolution: every pyramid level must
+# keep >= 2 rows per spatial shard (the per-model min_spatial_height bound,
+# parallel/spatial.py). 256 = 2 * 64 (flownet_s downsample) * 2 shards.
 H_CP = 256
 
 

@@ -1,0 +1,126 @@
+"""Learning-evidence artifact: drive synthetic val EPE below 1 px.
+
+Real FlyingChairs/Sintel data cannot be staged in this zero-egress
+container (DESIGN.md "Learning evidence"), so the quality proxy is the
+procedural dataset with exact ground truth (`data/datasets.py
+SyntheticData`): uniform-shift pairs, where the unsupervised objective's
+minimizer IS the true flow. This script trains FlowNet-S with the
+DEFAULT FlyingChairs loss configuration (Charbonnier, canonical
+smoothness, lambda=1, weights 16/8/4/2/1/1) and the FlyingChairs eval
+protocol (pr1 x 2, resize to GT resolution, AEE vs exact GT), recording
+EPE-vs-steps to artifacts/synthetic_fit.jsonl until EPE < 1 px.
+
+Run: python tools/synthetic_fit.py [--steps N] [--out PATH]
+(CPU: defaults to a 1-device mesh — this container has a single core, so
+an 8-device virtual mesh would only thrash it; pass --devices 8 to run
+the sharded path.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4000)
+    ap.add_argument("--eval-every", type=int, default=50)
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--target-epe", type=float, default=1.0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "synthetic_fit.jsonl"))
+    args = ap.parse_args()
+
+    force_cpu_devices(args.devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepof_tpu.core.config import (
+        DataConfig,
+        ExperimentConfig,
+        LossConfig,
+        MeshConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+    from deepof_tpu.train.evaluate import evaluate_aee
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+    from deepof_tpu.train.step import make_eval_fn, make_train_step
+
+    h = w = 64
+    batch = args.batch
+    cfg = ExperimentConfig(
+        name="synthetic_fit",
+        model="flownet_s",
+        # the DEFAULT FlyingChairs loss config (`flyingChairsWrapFlow.py:
+        # 43-49,120-123`): Charbonnier eps=1e-4 alpha_c=.25 alpha_s=.37,
+        # lambda_smooth=1, weights 16/8/4/2/1/1
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=args.lr),
+        data=DataConfig(dataset="synthetic", image_size=(h, w),
+                        gt_size=(h, w), batch_size=batch),
+        mesh=MeshConfig(),
+        # FlyingChairs eval protocol: pr1 x 2, clip, AEE at GT resolution
+        # (`flyingChairsTrain.py:264-296`)
+        train=TrainConfig(seed=0, eval_amplifier=2.0, eval_clip=(-300, 250),
+                          eval_batch_size=8,
+                          log_dir=os.path.dirname(args.out) or "."),
+    )
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data)
+    model = build_model("flownet_s")
+    tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
+    state = create_train_state(model, jnp.zeros((batch, h, w, 6)), tx, seed=0)
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    t0 = time.time()
+    with open(args.out, "w") as f:
+        f.write(json.dumps({
+            "kind": "meta", "model": cfg.model, "dataset": "synthetic",
+            "image_size": [h, w], "batch": batch, "lr": args.lr,
+            "loss": "default flyingchairs (charbonnier, canonical, "
+                    "lambda=1, weights 16/8/4/2/1/1)",
+            "eval": "pr1 x2, AEE at GT res, held-out synthetic val",
+        }) + "\n")
+        rng = np.random.RandomState(0)
+        for s in range(args.steps + 1):
+            if s % args.eval_every == 0:
+                res = evaluate_aee(eval_fn, state.params, ds, cfg)
+                rec = {"kind": "eval", "step": s,
+                       "aee": round(res["aee"], 4),
+                       "aae": round(res["aae"], 4),
+                       "val_loss": round(res["val_loss"], 4),
+                       "wall_s": round(time.time() - t0, 1)}
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                print(rec, flush=True)
+                if res["aee"] < args.target_epe:
+                    print(f"target EPE {args.target_epe} reached at step {s}",
+                          flush=True)
+                    return
+            b = jax.device_put(ds.sample_train(batch, rng=rng),
+                               batch_sharding(mesh))
+            state, _ = step(state, b)
+        print("step budget exhausted before target EPE", flush=True)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
