@@ -146,11 +146,12 @@ NOMINAL_BF16_TFLOPS = 197.0
 
 
 def step_flops(step, state, b) -> float | None:
-    """XLA's own FLOPs estimate for one compiled train step
-    (`jit(...).lower(...).compile().cost_analysis()`); None if the backend
-    does not report it."""
+    """XLA's own FLOPs estimate for one train step, from the LOWERED
+    module (`jit(...).lower(...).cost_analysis()`) — no second backend
+    compile, which matters on a tunnel whose compile latency swings;
+    None if the backend does not report it."""
     try:
-        ca = step.lower(state, b).compile().cost_analysis()
+        ca = step.lower(state, b).cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         flops = float(ca.get("flops", 0.0))
@@ -197,10 +198,12 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     # latter cancels tunnel-condition swings — DESIGN.md).
     flops = step_flops(step, state, b)
     if flops:
-        # cost_analysis reports PER-DEVICE (post-SPMD-partition) FLOPs
-        # (verified: an 8-way-sharded einsum reports 1/8 of global), so
-        # flops * steps/sec is already the per-chip rate — no /n_chips.
-        model_tflops = flops * res["steps_per_sec"] / 1e12
+        # LOWERED cost_analysis reports GLOBAL (pre-partition) FLOPs —
+        # verified: an 8-way-sharded einsum reports the full count from
+        # .lower().cost_analysis() and 1/8 of it from
+        # .compile().cost_analysis(). Per-chip rate therefore divides by
+        # n_chips.
+        model_tflops = flops * res["steps_per_sec"] / n_chips / 1e12
         res.update(
             flops_per_step=flops,
             model_tflops=round(model_tflops, 2),

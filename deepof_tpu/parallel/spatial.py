@@ -92,6 +92,29 @@ def min_spatial_height(max_downsample: int, spatial: int) -> int:
     return MIN_ROWS_PER_SHARD * max_downsample * spatial
 
 
+def spatial_cp_active(h: int, max_downsample: int, spatial: int) -> bool:
+    """True iff sharding H over `spatial` is gradient-safe for a model
+    downsampling by `max_downsample` (stride-2 SAME chain: each level is
+    ceil(previous/2)).
+
+    Probed-exact configurations (tools/halo_grad_repro.py) all satisfy,
+    probed-broken all violate: (a) the deepest level keeps >= 2 average
+    rows per shard, and (b) GSPMD's ceil-partition of the deepest level
+    leaves no shard with zero real rows (e.g. H=520 at downsample 64,
+    spatial=4: deepest ceil-chain gives 9 rows -> shards 3,3,3,0 — the
+    padded-empty shard re-enters the degenerate-halo regime and is
+    refused even though 9 >= 2*4 holds on average).
+    """
+    if h % spatial:
+        return False
+    d = h
+    for _ in range(max(max_downsample.bit_length() - 1, 0)):
+        d = -(-d // 2)
+    if d < MIN_ROWS_PER_SHARD * spatial:
+        return False
+    return d - (spatial - 1) * (-(-d // spatial)) > 0
+
+
 def constrain_batch(batch: dict, mesh: Mesh | None = None,
                     max_downsample: int = 64) -> dict:
     """Apply the spatial-CP sharding constraint to every image-like leaf
@@ -109,17 +132,13 @@ def constrain_batch(batch: dict, mesh: Mesh | None = None,
         return batch
     spatial = mesh.shape["spatial"]
     sharding = NamedSharding(mesh, P(("data",), "spatial"))
-    min_h = min_spatial_height(max_downsample, spatial)
 
     def put(v):
-        # H must divide max_downsample * spatial, not merely spatial:
-        # otherwise a deep level can end up with a row count that does not
-        # divide the shard count (e.g. H=520, spatial=4, downsample 64 ->
-        # 9 rows over 4 shards), whose padded last shard is exactly the
-        # <2-rows-per-shard degenerate regime again.
+        # Uneven deep levels are fine (probed: 5 rows over 2 shards, 10
+        # over 4 with a 1-real-row last shard — all exact); the precise
+        # gradient-safety gate lives in `spatial_cp_active`.
         if (getattr(v, "ndim", 0) >= 4
-                and v.shape[1] % (max_downsample * spatial) == 0
-                and v.shape[1] >= min_h):
+                and spatial_cp_active(v.shape[1], max_downsample, spatial)):
             return lax.with_sharding_constraint(v, sharding)
         return v
 

@@ -119,22 +119,20 @@ class Trainer:
 
         spatial = self.mesh.shape.get("spatial", 1)
         if spatial > 1:
-            from ..parallel.spatial import min_spatial_height
+            from ..parallel.spatial import min_spatial_height, spatial_cp_active
 
             h = (cfg.data.crop_size or cfg.data.image_size)[0]
             down = getattr(self.model, "max_downsample", 64)
-            min_h = min_spatial_height(down, spatial)
-            # mirror constrain_batch's activation condition exactly
-            # (parallel/spatial.py): below the gradient-safety bound OR not
-            # divisible down to the deepest level -> the constraint no-ops
-            if h < min_h or h % (down * spatial):
+            if not spatial_cp_active(h, down, spatial):
                 self.logger.log(
                     "warn", 0,
                     message=f"spatial CP inactive: H={h} fails the "
-                            f"gradient-safety gate (need H >= {min_h} and "
-                            f"H % {down * spatial} == 0 for {cfg.model} at "
-                            f"spatial={spatial}); those devices only "
-                            "replicate work")
+                            f"gradient-safety gate for {cfg.model} at "
+                            f"spatial={spatial} (need H >= "
+                            f"{min_spatial_height(down, spatial)}, H % "
+                            f"{spatial} == 0, and no empty deepest-level "
+                            "shard — parallel/spatial.py); those devices "
+                            "only replicate work")
 
         smooth_border = cfg.model in ("st_single", "st_baseline")
         self.train_step = make_train_step(self.model, cfg, self.dataset.mean,

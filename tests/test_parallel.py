@@ -148,13 +148,13 @@ def test_spatial_fence_below_bound():
     out64 = jax.jit(lambda b: constrain_batch(b, mesh=mesh,
                                               max_downsample=64))({"at": at})
     assert not out64["at"].sharding.is_equivalent_to(spatial_sh, 4)
-    # above the bound but NOT divisible by downsample*spatial (160 % 64):
-    # the deepest level would have a row count that does not divide the
-    # shard count — the padded-shard degenerate regime; must refuse
+    # above the bound with an UNEVEN deepest level (160/32 = 5 rows over
+    # 2 shards) is gradient-exact (tools/halo_grad_repro.py probes) and
+    # must shard — this is the flagship H=320 flownet_s case scaled down
     odd = jnp.zeros((4, 160, 32, 3))
     out_odd = jax.jit(lambda b: constrain_batch(b, mesh=mesh,
                                                 max_downsample=32))({"x": odd})
-    assert not out_odd["x"].sharding.is_equivalent_to(spatial_sh, 4)
+    assert out_odd["x"].sharding.is_equivalent_to(spatial_sh, 4)
 
 
 def test_time_axis_pair_parallel_volume():

@@ -11,6 +11,9 @@ partitioner, not the backend):
   - If every level keeps >= 2 rows per spatial shard, sharded and
     replicated gradients agree to float tolerance in every configuration
     tested (spatial 2 and 4, depths 2-5).
+  - Uneven deep levels are safe when the >=2-rows bound holds: probed
+    deepest levels of 5 rows over 2 shards and 10 over 4 (including a
+    1-real-row last shard from ceil-partitioning) are all exact.
   - Once the chain reaches a level with exactly 1 row per shard
     (H_level == spatial), the backward halo exchange of that level's conv
     mis-scales the input cotangent: EVERY upstream conv's gradient comes
@@ -98,3 +101,7 @@ if __name__ == "__main__":
     # sub-row collapse shows x2
     probe(4, 32, 3)
     probe(4, 32, 4)
+    # uneven deepest levels at >= 2 average rows/shard: exact
+    probe(2, 160, 5)
+    probe(2, 80, 4)
+    probe(4, 160, 4)
