@@ -28,11 +28,15 @@ well-tiled f32 VMEM operand (8x128 tiles); the public wrapper pads
 W -> 128 and H -> multiple of 8 and transposes from/to NHWC. Padded
 lanes/rows gather only clipped (valid) addresses and are sliced off.
 
-Backward: the VJP re-derives both cotangents (image and flow) via XLA
-autodiff of the jnp formulation — identical gradient semantics to the
-XLA path (flow grads through the bilinear blend weights, the same
-a.e.-derivative the reference's TF autodiff produced; image grads are
-the bilinear scatter); the forward hot path is the kernel.
+Backward: the FLOW cotangent — the only one the training loss ever uses
+(the warped operand is the target image, i.e. data: its cotangent is
+dead code under the loss) — is a second row-sweep kernel with the same
+single-VMEM-pass structure and no scatter: gu/gv are elementwise in the
+output position once the four bilinear neighbors are gathered, the same
+a.e.-derivative XLA autodiff produces (through the blend weights, zero
+through floor and clipped indices). The IMAGE cotangent (a bilinear
+scatter) is delegated to XLA autodiff of the jnp formulation and is
+dead-code-eliminated whenever the image is not differentiated.
 """
 
 from __future__ import annotations
@@ -50,9 +54,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 LANES = 128
 
 
-def _warp_kernel(img_ref, flow_ref, out_ref, *, h: int, w: int, c: int,
-                 hp: int):
-    """One batch element: img (1,C,Hp,128), flow (1,2,Hp,128) -> out."""
+def _bilinear_setup(flow_ref, h: int, w: int, hp: int):
+    """Shared index/weight setup for the forward and flow-grad kernels —
+    they MUST agree exactly (clip bounds, +1 neighbor offset) for the
+    gradient to match the primal. Returns (wx, wy, x0, x1, d0, d1)."""
     u = flow_ref[0, 0]
     v = flow_ref[0, 1]
     fu = jnp.floor(u)
@@ -65,8 +70,22 @@ def _warp_kernel(img_ref, flow_ref, out_ref, *, h: int, w: int, c: int,
     x1 = jnp.clip(j + fu.astype(jnp.int32) + 1, 0, w - 1)
     y0 = jnp.clip(i + fv.astype(jnp.int32), 0, h - 1)
     y1 = jnp.clip(i + fv.astype(jnp.int32) + 1, 0, h - 1)
-    d0 = y0 - i  # in [-(h-1), h-1] by construction (clip shrinks offsets)
-    d1 = y1 - i
+    # d0/d1 in [-(h-1), h-1] by construction (clip shrinks offsets)
+    return wx, wy, x0, x1, y0 - i, y1 - i
+
+
+def _to_planar(x, h: int, w: int, hp: int):
+    """NHWC -> channel-planar (B, C, Hp, 128), zero-padded to the kernels'
+    block shape."""
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, hp - h), (0, LANES - w), (0, 0)))
+    return jnp.transpose(xp, (0, 3, 1, 2))
+
+
+def _warp_kernel(img_ref, flow_ref, out_ref, *, h: int, w: int, c: int,
+                 hp: int):
+    """One batch element: img (1,C,Hp,128), flow (1,2,Hp,128) -> out."""
+    wx, wy, x0, x1, d0, d1 = _bilinear_setup(flow_ref, h, w, hp)
 
     def body(k, accs):
         dy = k - (h - 1)
@@ -89,6 +108,68 @@ def _warp_kernel(img_ref, flow_ref, out_ref, *, h: int, w: int, c: int,
         out_ref[0, ch] = accs[ch]
 
 
+def _warp_flow_grad_kernel(img_ref, flow_ref, ct_ref, out_ref, *, h: int,
+                           w: int, c: int, hp: int):
+    """One batch element: img (1,C,Hp,128), flow (1,2,Hp,128), cotangent
+    (1,C,Hp,128) -> (1,2,Hp,128) = (dL/du, dL/dv).
+
+    Same bounded row sweep as the forward. With the bilinear blend
+    recon = (1-wy)[(1-wx)Ia + wx Ib] + wy[(1-wx)Ic + wx Id]:
+      d/du = (1-wy)(Ib-Ia) + wy(Id-Ic)
+      d/dv = (1-wx)(Ic-Ia) + wx(Id-Ib)
+    where Ia/Ib live on the y0 row (mask m0) and Ic/Id on y1 (m1), so per
+    row-offset dy both terms reduce to masked combinations of the two
+    lane gathers g0=img[.,x0], g1=img[.,x1] — no scatter anywhere.
+    """
+    wx, wy, x0, x1, d0, d1 = _bilinear_setup(flow_ref, h, w, hp)
+
+    def body(k, accs):
+        au, av = accs
+        dy = k - (h - 1)
+        shift = (hp - dy) % hp
+        m0 = (d0 == dy).astype(jnp.float32)
+        m1 = (d1 == dy).astype(jnp.float32)
+        wu = (1.0 - wy) * m0 + wy * m1
+        wv = m1 - m0
+        for ch in range(c):
+            plane = pltpu.roll(img_ref[0, ch], shift, 0)
+            g0 = jnp.take_along_axis(plane, x0, axis=1)
+            g1 = jnp.take_along_axis(plane, x1, axis=1)
+            gc = ct_ref[0, ch]
+            au = au + gc * wu * (g1 - g0)
+            av = av + gc * wv * ((1.0 - wx) * g0 + wx * g1)
+        return au, av
+
+    zero = jnp.zeros((hp, LANES), jnp.float32)
+    au, av = lax.fori_loop(0, 2 * h - 1, body, (zero, zero))
+    out_ref[0, 0] = au
+    out_ref[0, 1] = av
+
+
+def _pallas_warp_flow_grad(image: jnp.ndarray, flow: jnp.ndarray,
+                           ct: jnp.ndarray, interpret: bool) -> jnp.ndarray:
+    b, h, w, c = image.shape
+    hp = -(-h // 8) * 8
+    out = pl.pallas_call(
+        functools.partial(_warp_flow_grad_kernel, h=h, w=w, c=c, hp=hp),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, c, hp, LANES), lambda bi: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, hp, LANES), lambda bi: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c, hp, LANES), lambda bi: (bi, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 2, hp, LANES), lambda bi: (bi, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, 2, hp, LANES), jnp.float32),
+        interpret=interpret,
+    )(_to_planar(image, h, w, hp), _to_planar(flow, h, w, hp),
+      _to_planar(ct, h, w, hp))
+    return jnp.transpose(out, (0, 2, 3, 1))[:, :h, :w]
+
+
 def _pallas_warp_fwd(image: jnp.ndarray, flow: jnp.ndarray,
                      interpret: bool) -> jnp.ndarray:
     b, h, w, c = image.shape
@@ -97,12 +178,8 @@ def _pallas_warp_fwd(image: jnp.ndarray, flow: jnp.ndarray,
             f"pallas warp requires W <= {LANES} (got {w}); use the XLA path "
             "for fine pyramid levels")
     hp = -(-h // 8) * 8
-    imgp = jnp.pad(image.astype(jnp.float32),
-                   ((0, 0), (0, hp - h), (0, LANES - w), (0, 0)))
-    flowp = jnp.pad(flow.astype(jnp.float32),
-                    ((0, 0), (0, hp - h), (0, LANES - w), (0, 0)))
-    imgp = jnp.transpose(imgp, (0, 3, 1, 2))   # (B, C, Hp, 128)
-    flowp = jnp.transpose(flowp, (0, 3, 1, 2))  # (B, 2, Hp, 128)
+    imgp = _to_planar(image, h, w, hp)   # (B, C, Hp, 128)
+    flowp = _to_planar(flow, h, w, hp)   # (B, 2, Hp, 128)
 
     out = pl.pallas_call(
         functools.partial(_warp_kernel, h=h, w=w, c=c, hp=hp),
@@ -121,13 +198,12 @@ def _pallas_warp_fwd(image: jnp.ndarray, flow: jnp.ndarray,
     return jnp.transpose(out, (0, 2, 3, 1))[:, :h, :w].astype(image.dtype)
 
 
-@functools.lru_cache(maxsize=None)
-def _partitioned_fwd(interpret: bool):
-    """Batch-data-parallel partitioning (same rationale as pallas/corr.py:
-    GSPMD cannot see inside the kernel; the warp is independent per batch
-    element but the row sweep needs the full H per shard)."""
-    fwd = custom_partitioning(
-        lambda image, flow: _pallas_warp_fwd(image, flow, interpret))
+def _batch_partitioned(lower_fn, n_in: int, sharding_rule: str):
+    """Batch-data-parallel custom_partitioning wrapper shared by both warp
+    kernels (same rationale as pallas/corr.py: GSPMD cannot see inside a
+    kernel; the warp is independent per batch element but the row sweep
+    needs the full H per shard)."""
+    fn = custom_partitioning(lower_fn)
 
     def _batch_axis(arg_infos):
         for info in arg_infos:
@@ -142,19 +218,30 @@ def _partitioned_fwd(interpret: bool):
 
     def partition(mesh, arg_infos, result_infos):
         sh = NamedSharding(mesh, P(_batch_axis(arg_infos), None, None, None))
+        return mesh, lower_fn, sh, (sh,) * n_in
 
-        def lower(image, flow):
-            return _pallas_warp_fwd(image, flow, interpret)
-
-        return mesh, lower, sh, (sh, sh)
-
-    fwd.def_partition(
+    fn.def_partition(
         infer_sharding_from_operands=infer,
         partition=partition,
-        sharding_rule="b h w c, b h w k -> b h w c",
+        sharding_rule=sharding_rule,
         need_replication_factors=("h", "w", "c", "k"),
     )
-    return fwd
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_fwd(interpret: bool):
+    return _batch_partitioned(
+        lambda image, flow: _pallas_warp_fwd(image, flow, interpret),
+        n_in=2, sharding_rule="b h w c, b h w k -> b h w c")
+
+
+@functools.lru_cache(maxsize=None)
+def _partitioned_flow_grad(interpret: bool):
+    return _batch_partitioned(
+        lambda image, flow, ct: _pallas_warp_flow_grad(image, flow, ct,
+                                                       interpret),
+        n_in=3, sharding_rule="b h w c, b h w k, b h w c -> b h w k")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -178,12 +265,20 @@ def _fwd(image, flow, interpret):
     return _partitioned_fwd(interpret)(image, flow), (image, flow)
 
 
-def _bwd(_interpret, res, g):
+def _bwd(interpret, res, g):
     from ..warp import backward_warp  # jnp formulation; same a.e. gradient
 
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     image, flow = res
-    _, vjp = jax.vjp(backward_warp, image, flow)
-    gi, gf = vjp(g.astype(jnp.float32))
+    g32 = g.astype(jnp.float32)
+    # flow cotangent: the training hot path (the model's only gradient
+    # route through the warp) — fused Pallas sweep, no scatter
+    gf = _partitioned_flow_grad(interpret)(image, flow, g32)
+    # image cotangent: XLA bilinear scatter; under jit it is dead-code-
+    # eliminated when the image operand is data (the default loss). Eager
+    # op-by-op grads do pay it — debug-only territory
+    gi = jax.vjp(lambda im: backward_warp(im, flow), image)[1](g32)[0]
     return gi.astype(image.dtype), gf.astype(flow.dtype)
 
 

@@ -101,3 +101,16 @@ def test_auto_impl_dispatch(rng):
     wflow = jnp.zeros((1, 8, 200, 2))
     out = backward_warp(wide, wflow, impl="auto")  # falls back to xla
     np.testing.assert_allclose(np.asarray(out), np.asarray(wide), atol=1e-6)
+
+
+def test_pallas_flow_grad_clipped_and_flow_only(rng):
+    """The Pallas flow-cotangent kernel on heavily clipped flows (all four
+    bilinear neighbors at the border), differentiated wrt flow ONLY — the
+    training hot path, where the image cotangent is dead code."""
+    img = jnp.asarray(rng.rand(2, 8, 10, 3), jnp.float32)
+    flow = jnp.asarray(rng.randn(2, 8, 10, 2) * 50.0, jnp.float32)
+
+    gp = jax.grad(lambda f: jnp.sum(backward_warp_pallas(img, f) ** 2))(flow)
+    gx = jax.grad(lambda f: jnp.sum(backward_warp(img, f) ** 2))(flow)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               rtol=1e-5, atol=1e-5)
