@@ -30,13 +30,24 @@ _failed = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _LIB_PATH]
-    try:
-        res = subprocess.run(cmd, capture_output=True, timeout=180)
-        return res.returncode == 0
-    except (OSError, subprocess.TimeoutExpired):
-        return False
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+    # full build with PNG/JPEG codecs first; fall back to PPM-only when
+    # the dev libraries are absent (the Python side keeps cv2 for the rest)
+    variants = [
+        base + ["-DDEEPOF_HAVE_PNG", "-DDEEPOF_HAVE_JPEG", _SRC,
+                "-lpng", "-ljpeg", "-o", _LIB_PATH],
+        base + [_SRC, "-o", _LIB_PATH],
+    ]
+    for cmd in variants:
+        try:
+            res = subprocess.run(cmd, capture_output=True, timeout=180)
+            if res.returncode == 0:
+                return True
+        except OSError:  # no g++ at all — no variant can succeed
+            return False
+        except subprocess.TimeoutExpired:
+            continue  # loaded host: still try the cheaper PPM-only build
+    return False
 
 
 def _load() -> ctypes.CDLL | None:
@@ -68,9 +79,17 @@ def _load() -> ctypes.CDLL | None:
                                         ctypes.c_int]
         lib.deepof_read_flo_batch.argtypes = [c_char_pp, ctypes.c_int, f32_p,
                                               ctypes.c_int, ctypes.c_int]
+        lib.deepof_decode_image.argtypes = [ctypes.c_char_p, f32_p,
+                                            ctypes.c_int, ctypes.c_int]
+        lib.deepof_image_supported.argtypes = [ctypes.c_char_p]
+        lib.deepof_decode_image_batch.argtypes = [c_char_pp, ctypes.c_int,
+                                                  f32_p, ctypes.c_int,
+                                                  ctypes.c_int]
         for fn in ("deepof_decode_ppm", "deepof_ppm_dims",
                    "deepof_decode_ppm_batch", "deepof_flo_dims",
-                   "deepof_read_flo", "deepof_read_flo_batch"):
+                   "deepof_read_flo", "deepof_read_flo_batch",
+                   "deepof_decode_image", "deepof_image_supported",
+                   "deepof_decode_image_batch"):
             getattr(lib, fn).restype = ctypes.c_int
         _lib = lib
         return _lib
@@ -87,19 +106,32 @@ def _paths_array(paths: list[str]):
 
 
 def decode_ppm_batch(paths: list[str], size: tuple[int, int]) -> np.ndarray:
-    """Parallel-decode PPMs to (N, H, W, 3) float32 BGR resized to `size`."""
+    """Parallel-decode PPMs to (N, H, W, 3) float32 BGR resized to `size`
+    (the generic decoder dispatches PPM by magic bytes)."""
+    return decode_image_batch(paths, size)
+
+
+def decode_image_batch(paths: list[str], size: tuple[int, int]) -> np.ndarray:
+    """Parallel-decode images (PPM/PNG/JPEG by magic bytes, mixed formats
+    allowed) to (N, H, W, 3) float32 BGR resized to `size`."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native IO library unavailable")
     h, w = size
     out = np.empty((len(paths), h, w, 3), np.float32)
-    failures = lib.deepof_decode_ppm_batch(
+    failures = lib.deepof_decode_image_batch(
         _paths_array(paths), len(paths),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), h, w)
     if failures:
-        raise IOError(f"native PPM decode failed for {failures} file(s) "
+        raise IOError(f"native image decode failed for {failures} file(s) "
                       f"in batch of {len(paths)}")
     return out
+
+
+def image_supported(path: str) -> bool:
+    """True iff this build's codecs can decode `path` (by magic bytes)."""
+    lib = _load()
+    return bool(lib is not None and lib.deepof_image_supported(path.encode()))
 
 
 def read_flo_batch(paths: list[str], size: tuple[int, int]) -> np.ndarray:

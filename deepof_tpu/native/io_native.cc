@@ -1,6 +1,6 @@
-// Native host-side IO for the data pipeline: PPM (P6) decode, Middlebury
-// .flo parse, bilinear resize, and a persistent thread pool for batch
-// assembly.
+// Native host-side IO for the data pipeline: PPM (P6) / PNG / JPEG
+// decode, Middlebury .flo parse, bilinear resize, and a persistent
+// thread pool for batch assembly.
 //
 // The reference's loaders decode every image synchronously in Python per
 // training step (`sintelLoader.py:85`, SURVEY.md §7.3.4) — at TPU step
@@ -8,8 +8,11 @@
 // batch in parallel outside the GIL; Python binds via ctypes
 // (deepof_tpu/native/__init__.py), no pybind11 dependency.
 //
-// Build: g++ -O3 -march=native -shared -fPIC -std=c++17 -pthread
-//        io_native.cc -o libdeepof_io.so
+// Build (full): g++ -O3 -shared -fPIC -std=c++17 -pthread
+//   -DDEEPOF_HAVE_PNG -DDEEPOF_HAVE_JPEG io_native.cc -lpng -ljpeg
+//   -o libdeepof_io.so
+// Without the codec defines the library builds with PPM+.flo only
+// (the Python side falls back to cv2 for PNG/JPEG).
 
 #include <algorithm>
 #include <atomic>
@@ -23,6 +26,15 @@
 #include <queue>
 #include <thread>
 #include <vector>
+
+#ifdef DEEPOF_HAVE_PNG
+#include <png.h>
+#endif
+#ifdef DEEPOF_HAVE_JPEG
+#include <csetjmp>
+
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -93,6 +105,8 @@ struct Latch {
   std::condition_variable cv;
 };
 
+constexpr int kMaxDim = 1 << 16;
+
 // ------------------------------------------------------------------ PPM (P6)
 bool read_ppm_dims(FILE* f, int* w, int* h) {
   char magic[3] = {0};
@@ -114,7 +128,6 @@ bool read_ppm_dims(FILE* f, int* w, int* h) {
   if (vals[2] != 255) return false;
   // range-check: reject absurd/negative dims before any allocation (a
   // corrupt header must fail the call, not throw on a pool thread)
-  constexpr int kMaxDim = 1 << 16;
   if (vals[0] <= 0 || vals[1] <= 0 || vals[0] > kMaxDim || vals[1] > kMaxDim)
     return false;
   *w = vals[0];
@@ -136,6 +149,103 @@ bool decode_ppm_file(const char* path, std::vector<uint8_t>* buf, int* w,
   bool ok = fread(buf->data(), 1, n, f) == n;
   fclose(f);
   return ok;
+}
+
+#ifdef DEEPOF_HAVE_PNG
+// decode one PNG into interleaved uint8 RGB via libpng's simplified API
+bool decode_png_file(const char* path, std::vector<uint8_t>* buf, int* w,
+                     int* h) {
+  png_image image;
+  memset(&image, 0, sizeof image);
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_file(&image, path)) return false;
+  image.format = PNG_FORMAT_RGB;
+  *w = static_cast<int>(image.width);
+  *h = static_cast<int>(image.height);
+  if (*w <= 0 || *h <= 0 || *w > kMaxDim || *h > kMaxDim) {
+    png_image_free(&image);
+    return false;
+  }
+  buf->resize(PNG_IMAGE_SIZE(image));
+  if (!png_image_finish_read(&image, nullptr, buf->data(), 0, nullptr)) {
+    png_image_free(&image);
+    return false;
+  }
+  return true;
+}
+#endif  // DEEPOF_HAVE_PNG
+
+#ifdef DEEPOF_HAVE_JPEG
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// decode one JPEG into interleaved uint8 RGB (libjpeg classic API; errors
+// longjmp back instead of exiting the process)
+bool decode_jpeg_file(const char* path, std::vector<uint8_t>* buf, int* w,
+                      int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  if (*w <= 0 || *h <= 0 || *w > kMaxDim || *h > kMaxDim ||
+      cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  buf->resize(static_cast<size_t>(*w) * (*h) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row =
+        buf->data() + static_cast<size_t>(cinfo.output_scanline) * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return true;
+}
+#endif  // DEEPOF_HAVE_JPEG
+
+// dispatch PPM / PNG / JPEG by magic bytes
+bool decode_image_file(const char* path, std::vector<uint8_t>* buf, int* w,
+                       int* h) {
+  unsigned char sig[2] = {0, 0};
+  {
+    FILE* f = fopen(path, "rb");
+    if (!f) return false;
+    size_t n = fread(sig, 1, 2, f);
+    fclose(f);
+    if (n < 2) return false;
+  }
+  if (sig[0] == 'P' && sig[1] == '6') return decode_ppm_file(path, buf, w, h);
+#ifdef DEEPOF_HAVE_PNG
+  if (sig[0] == 0x89 && sig[1] == 'P') return decode_png_file(path, buf, w, h);
+#endif
+#ifdef DEEPOF_HAVE_JPEG
+  if (sig[0] == 0xFF && sig[1] == 0xD8)
+    return decode_jpeg_file(path, buf, w, h);
+#endif
+  return false;
 }
 
 // -------------------------------------------------------- bilinear resize
@@ -189,6 +299,57 @@ int deepof_decode_ppm(const char* path, float* out, int dh, int dw) {
   return 0;
 }
 
+// Decode one PPM/PNG/JPEG (dispatch by magic) to float32 BGR resized to
+// (dh, dw). Returns 0 on success.
+int deepof_decode_image(const char* path, float* out, int dh, int dw) {
+  std::vector<uint8_t> buf;
+  int w, h;
+  if (!decode_image_file(path, &buf, &w, &h)) return 1;
+  resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
+  return 0;
+}
+
+// 1 iff this build can decode `path`'s format (by magic bytes).
+int deepof_image_supported(const char* path) {
+  unsigned char sig[2] = {0, 0};
+  FILE* f = fopen(path, "rb");
+  if (!f) return 0;
+  size_t n = fread(sig, 1, 2, f);
+  fclose(f);
+  if (n < 2) return 0;
+  if (sig[0] == 'P' && sig[1] == '6') return 1;
+#ifdef DEEPOF_HAVE_PNG
+  if (sig[0] == 0x89 && sig[1] == 'P') return 1;
+#endif
+#ifdef DEEPOF_HAVE_JPEG
+  if (sig[0] == 0xFF && sig[1] == 0xD8) return 1;
+#endif
+  return 0;
+}
+
+// Decode a batch of images (mixed formats allowed) in parallel into
+// (n, dh, dw, 3) float32 BGR. Returns number of failures.
+int deepof_decode_image_batch(const char** paths, int n, float* out, int dh,
+                              int dw) {
+  Latch latch(n);
+  std::atomic<int> failures{0};
+  const size_t stride = static_cast<size_t>(dh) * dw * 3;
+  for (int i = 0; i < n; ++i) {
+    const char* p = paths[i];
+    float* dst = out + stride * i;
+    pool()->submit([p, dst, dh, dw, &latch, &failures] {
+      try {
+        if (deepof_decode_image(p, dst, dh, dw) != 0) failures++;
+      } catch (...) {  // never let an exception escape a pool thread
+        failures++;
+      }
+      latch.done();
+    });
+  }
+  latch.wait();
+  return failures.load();
+}
+
 // Probe a PPM's native dims.
 int deepof_ppm_dims(const char* path, int* h, int* w) {
   FILE* f = fopen(path, "rb");
@@ -198,27 +359,11 @@ int deepof_ppm_dims(const char* path, int* h, int* w) {
   return ok ? 0 : 1;
 }
 
-// Decode a batch of PPMs in parallel into (n, dh, dw, 3) float32 BGR.
-// paths: array of n C strings. Returns number of failures.
+// Decode a batch of PPMs (kept for ABI compat; the generic image batch
+// dispatches PPM by magic bytes). Returns number of failures.
 int deepof_decode_ppm_batch(const char** paths, int n, float* out, int dh,
                             int dw) {
-  Latch latch(n);
-  std::atomic<int> failures{0};
-  const size_t stride = static_cast<size_t>(dh) * dw * 3;
-  for (int i = 0; i < n; ++i) {
-    const char* p = paths[i];
-    float* dst = out + stride * i;
-    pool()->submit([p, dst, dh, dw, &latch, &failures] {
-      try {
-        if (deepof_decode_ppm(p, dst, dh, dw) != 0) failures++;
-      } catch (...) {  // never let an exception escape a pool thread
-        failures++;
-      }
-      latch.done();
-    });
-  }
-  latch.wait();
-  return failures.load();
+  return deepof_decode_image_batch(paths, n, out, dh, dw);
 }
 
 // Middlebury .flo: magic float 202021.25, int32 w, int32 h, then

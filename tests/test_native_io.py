@@ -109,3 +109,84 @@ def test_native_flo_dim_mismatch_fails(chairs_dir):
     with pytest.raises(IOError):
         native.read_flo_batch([str(chairs_dir / "00001_flow.flo"),
                                str(small)], (64, 96))
+
+
+def test_native_png_decode_matches_cv2(tmp_path, rng):
+    img = rng.randint(0, 255, (40, 56, 3), dtype=np.uint8)
+    p = str(tmp_path / "x.png")
+    cv2.imwrite(p, img)
+    if not native.image_supported(p):
+        pytest.skip("library built without PNG codec")
+    got = native.decode_image_batch([p], (40, 56))[0]
+    np.testing.assert_allclose(got, img.astype(np.float32), atol=0.01)
+
+
+def test_native_jpeg_decode_close_to_cv2(tmp_path, rng):
+    # JPEG decode is not bit-exact across libjpeg builds; compare loosely
+    img = rng.randint(0, 255, (40, 56, 3), dtype=np.uint8)
+    p = str(tmp_path / "x.jpg")
+    cv2.imwrite(p, img, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    if not native.image_supported(p):
+        pytest.skip("library built without JPEG codec")
+    got = native.decode_image_batch([p], (40, 56))[0]
+    want = cv2.imread(p, cv2.IMREAD_COLOR).astype(np.float32)
+    assert np.abs(got - want).mean() < 2.0
+
+
+def test_sintel_native_batch_matches_python(tmp_path, rng):
+    from deepof_tpu.data.datasets import SintelData
+    from deepof_tpu.io.flo import write_flo as wf
+
+    for clip in ("alley_1", "bamboo_2"):
+        img_dir = tmp_path / "training" / "final" / clip
+        flow_dir = tmp_path / "training" / "flow" / clip
+        img_dir.mkdir(parents=True)
+        flow_dir.mkdir(parents=True)
+        for f in range(1, 5):
+            cv2.imwrite(str(img_dir / f"frame_{f:04d}.png"),
+                        rng.randint(0, 255, (32, 64, 3), np.uint8))
+            if f < 4:
+                wf(str(flow_dir / f"frame_{f:04d}.flo"),
+                   rng.randn(32, 64, 2).astype(np.float32))
+    cfg = DataConfig(dataset="sintel", data_path=str(tmp_path),
+                     image_size=(32, 64), gt_size=(32, 64), time_step=3,
+                     sintel_pass="final", crop_size=(16, 32),
+                     cache_decoded=False)
+    ds = SintelData(cfg)
+    if not native.image_supported(ds.windows[0][0]):
+        pytest.skip("library built without PNG codec")
+    assert ds._native_batch([0, 1]) is not None  # native path active
+    bn = ds.sample_train(2, rng=np.random.RandomState(7))
+    ds2 = SintelData(cfg)
+    ds2._native_batch = lambda idxs, crop_rng=None: None
+    bp = ds2.sample_train(2, rng=np.random.RandomState(7))
+    assert bn["volume"].shape == bp["volume"].shape == (2, 16, 32, 9)
+    np.testing.assert_allclose(bn["volume"], bp["volume"], atol=0.01)
+    np.testing.assert_array_equal(bn["flow"], bp["flow"])
+
+
+def test_ucf101_native_batch_matches_python(tmp_path, rng):
+    from deepof_tpu.data.datasets import UCF101Data
+
+    for ci, cls in enumerate(("ApplyEyeMakeup", "Archery")):
+        clip = tmp_path / "frames" / cls / f"v_{cls}_g09_c01"
+        clip.mkdir(parents=True)
+        for f in range(3):
+            cv2.imwrite(str(clip / f"f{f}.jpg"),
+                        rng.randint(0, 255, (24, 32, 3), np.uint8))
+    cfg = DataConfig(dataset="ucf101", data_path=str(tmp_path),
+                     image_size=(24, 32), cache_decoded=False)
+    ds = UCF101Data(cfg)
+    first = ds.train_clips[0][0][0]
+    if not native.image_supported(first):
+        pytest.skip("library built without JPEG codec")
+    bn = ds.sample_train(2, rng=np.random.RandomState(3))
+    cfg2 = DataConfig(dataset="ucf101", data_path=str(tmp_path),
+                      image_size=(24, 32), cache_decoded=True)  # python path
+    ds2 = UCF101Data(cfg2)
+    bp = ds2.sample_train(2, rng=np.random.RandomState(3))
+    np.testing.assert_array_equal(bn["label"], bp["label"])
+    # same frames picked (shared rng order); JPEG decoders may differ by
+    # a few LSBs between libjpeg variants
+    assert np.abs(bn["source"] - bp["source"]).mean() < 2.0
+    assert np.abs(bn["target"] - bp["target"]).mean() < 2.0
