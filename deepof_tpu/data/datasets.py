@@ -385,9 +385,7 @@ class UCF101Data:
         streaming mode, cv2 + decoded cache otherwise."""
         from .. import native
 
-        if self.cfg.cache_decoded:
-            pass
-        else:
+        if not self.cfg.cache_decoded:
             if self._native_ok is None:  # probe the build's codecs once
                 self._native_ok = (native.available()
                                    and native.image_supported(paths[0]))
@@ -425,7 +423,8 @@ class SyntheticData:
     mean = (0.0, 0.0, 0.0)
 
     def __init__(self, cfg: DataConfig, num_train: int = 64, num_val: int = 16,
-                 max_shift: float = 4.0, feature_scale: int = 8):
+                 max_shift: float = 4.0, feature_scale: int = 8,
+                 style: str = "noise"):
         self.cfg = cfg
         self.num_train, self.num_val = num_train, num_val
         self._max_shift = max_shift
@@ -434,13 +433,25 @@ class SyntheticData:
         # feature_scale must comfortably exceed max_shift for the
         # unsupervised objective to be optimizable from a zero-flow init
         self._feature_scale = feature_scale
+        # "noise": upscaled random noise (quasi-periodic — its smoothed
+        # autocorrelation has NEGATIVE lobes near the feature scale, so the
+        # finest-level photometric gradient at zero flow can point away
+        # from the true shift). "blobs": sparse Gaussian blobs on a smooth
+        # gradient background — autocorrelation positive and monotone past
+        # max_shift at every pyramid level, the optimizable regime for the
+        # unsupervised objective.
+        self._style = style
 
     def _sample(self, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         rng = np.random.RandomState(seed)
         h, w = self.cfg.image_size
-        fs = self._feature_scale
-        base = rng.rand(h // fs + 2, w // fs + 2, 3).astype(np.float32) * 255.0
-        img = cv2.resize(base, (w + 16, h + 16), interpolation=cv2.INTER_CUBIC)
+        if self._style == "blobs":
+            img = self._blob_canvas(rng, h + 16, w + 16)
+        else:
+            fs = self._feature_scale
+            base = rng.rand(h // fs + 2, w // fs + 2, 3).astype(np.float32) * 255.0
+            img = cv2.resize(base, (w + 16, h + 16),
+                             interpolation=cv2.INTER_CUBIC)
         u, v = rng.randint(-self._max_shift, self._max_shift + 1, 2)
         src = img[8 : 8 + h, 8 : 8 + w]
         tgt = img[8 + v : 8 + v + h, 8 + u : 8 + u + w]
@@ -451,6 +462,23 @@ class SyntheticData:
             np.asarray([-u, -v], np.float32), (h, w, 2)
         ).copy()
         return src, tgt, flow
+
+    def _blob_canvas(self, rng, ch: int, cw: int) -> np.ndarray:
+        """Smooth linear-gradient background + sparse Gaussian blobs
+        (sigma ~ max_shift or wider): unambiguous structure whose local
+        autocorrelation peaks only at the true displacement."""
+        yy, xx = np.mgrid[0:ch, 0:cw].astype(np.float32)
+        gdir = rng.rand(2) * 2 - 1
+        bg = 60.0 + 60.0 * (gdir[0] * yy / ch + gdir[1] * xx / cw + 1.0)
+        img = np.repeat(bg[..., None], 3, axis=-1)
+        sigma = max(self._max_shift, 3.0)
+        for _ in range(8):
+            cy, cx = rng.rand(2) * [ch - 1, cw - 1]
+            color = rng.rand(3) * 200.0 - 100.0
+            s = sigma * (0.8 + 0.6 * rng.rand())
+            blob = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * s * s))
+            img += blob[..., None] * color
+        return np.clip(img, 0.0, 255.0).astype(np.float32)
 
     def _batch(self, seeds) -> dict:
         srcs, tgts, flows = zip(*(self._sample(int(s)) for s in seeds))

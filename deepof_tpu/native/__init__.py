@@ -36,6 +36,8 @@ def _build() -> bool:
     variants = [
         base + ["-DDEEPOF_HAVE_PNG", "-DDEEPOF_HAVE_JPEG", _SRC,
                 "-lpng", "-ljpeg", "-o", _LIB_PATH],
+        base + ["-DDEEPOF_HAVE_PNG", _SRC, "-lpng", "-o", _LIB_PATH],
+        base + ["-DDEEPOF_HAVE_JPEG", _SRC, "-ljpeg", "-o", _LIB_PATH],
         base + [_SRC, "-o", _LIB_PATH],
     ]
     for cmd in variants:

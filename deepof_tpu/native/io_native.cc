@@ -135,30 +135,31 @@ bool read_ppm_dims(FILE* f, int* w, int* h) {
   return true;
 }
 
+// decode one P6 stream (positioned at the magic) into uint8 RGB
+bool decode_ppm_stream(FILE* f, std::vector<uint8_t>* buf, int* w, int* h) {
+  if (!read_ppm_dims(f, w, h)) return false;
+  size_t n = static_cast<size_t>(*w) * (*h) * 3;
+  buf->resize(n);
+  return fread(buf->data(), 1, n, f) == n;
+}
+
 // decode one P6 file into interleaved uint8 RGB (native size)
 bool decode_ppm_file(const char* path, std::vector<uint8_t>* buf, int* w,
                      int* h) {
   FILE* f = fopen(path, "rb");
   if (!f) return false;
-  if (!read_ppm_dims(f, w, h)) {
-    fclose(f);
-    return false;
-  }
-  size_t n = static_cast<size_t>(*w) * (*h) * 3;
-  buf->resize(n);
-  bool ok = fread(buf->data(), 1, n, f) == n;
+  bool ok = decode_ppm_stream(f, buf, w, h);
   fclose(f);
   return ok;
 }
 
 #ifdef DEEPOF_HAVE_PNG
-// decode one PNG into interleaved uint8 RGB via libpng's simplified API
-bool decode_png_file(const char* path, std::vector<uint8_t>* buf, int* w,
-                     int* h) {
+// decode one PNG stream (positioned at byte 0) via libpng's simplified API
+bool decode_png_stream(FILE* f, std::vector<uint8_t>* buf, int* w, int* h) {
   png_image image;
   memset(&image, 0, sizeof image);
   image.version = PNG_IMAGE_VERSION;
-  if (!png_image_begin_read_from_file(&image, path)) return false;
+  if (!png_image_begin_read_from_stdio(&image, f)) return false;
   image.format = PNG_FORMAT_RGB;
   *w = static_cast<int>(image.width);
   *h = static_cast<int>(image.height);
@@ -185,19 +186,15 @@ void jpeg_err_exit(j_common_ptr cinfo) {
   longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
 }
 
-// decode one JPEG into interleaved uint8 RGB (libjpeg classic API; errors
-// longjmp back instead of exiting the process)
-bool decode_jpeg_file(const char* path, std::vector<uint8_t>* buf, int* w,
-                      int* h) {
-  FILE* f = fopen(path, "rb");
-  if (!f) return false;
+// decode one JPEG stream (positioned at byte 0; libjpeg classic API;
+// errors longjmp back instead of exiting the process)
+bool decode_jpeg_stream(FILE* f, std::vector<uint8_t>* buf, int* w, int* h) {
   jpeg_decompress_struct cinfo;
   JpegErr err;
   cinfo.err = jpeg_std_error(&err.mgr);
   err.mgr.error_exit = jpeg_err_exit;
   if (setjmp(err.jb)) {
     jpeg_destroy_decompress(&cinfo);
-    fclose(f);
     return false;
   }
   jpeg_create_decompress(&cinfo);
@@ -210,7 +207,6 @@ bool decode_jpeg_file(const char* path, std::vector<uint8_t>* buf, int* w,
   if (*w <= 0 || *h <= 0 || *w > kMaxDim || *h > kMaxDim ||
       cinfo.output_components != 3) {
     jpeg_destroy_decompress(&cinfo);
-    fclose(f);
     return false;
   }
   buf->resize(static_cast<size_t>(*w) * (*h) * 3);
@@ -221,31 +217,56 @@ bool decode_jpeg_file(const char* path, std::vector<uint8_t>* buf, int* w,
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
-  fclose(f);
   return true;
 }
 #endif  // DEEPOF_HAVE_JPEG
 
-// dispatch PPM / PNG / JPEG by magic bytes
-bool decode_image_file(const char* path, std::vector<uint8_t>* buf, int* w,
-                       int* h) {
-  unsigned char sig[2] = {0, 0};
-  {
-    FILE* f = fopen(path, "rb");
-    if (!f) return false;
-    size_t n = fread(sig, 1, 2, f);
-    fclose(f);
-    if (n < 2) return false;
-  }
-  if (sig[0] == 'P' && sig[1] == '6') return decode_ppm_file(path, buf, w, h);
+enum class ImgFormat { kUnsupported, kPpm, kPng, kJpeg };
+
+// the ONE magic-byte table (decode + the Python-side support probe)
+ImgFormat sniff_format(const unsigned char sig[2]) {
+  if (sig[0] == 'P' && sig[1] == '6') return ImgFormat::kPpm;
 #ifdef DEEPOF_HAVE_PNG
-  if (sig[0] == 0x89 && sig[1] == 'P') return decode_png_file(path, buf, w, h);
+  if (sig[0] == 0x89 && sig[1] == 'P') return ImgFormat::kPng;
 #endif
 #ifdef DEEPOF_HAVE_JPEG
-  if (sig[0] == 0xFF && sig[1] == 0xD8)
-    return decode_jpeg_file(path, buf, w, h);
+  if (sig[0] == 0xFF && sig[1] == 0xD8) return ImgFormat::kJpeg;
 #endif
-  return false;
+  return ImgFormat::kUnsupported;
+}
+
+// dispatch PPM / PNG / JPEG by magic bytes; ONE open per file (the sniffed
+// bytes are pushed back via rewind before the codec runs)
+bool decode_image_file(const char* path, std::vector<uint8_t>* buf, int* w,
+                       int* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  unsigned char sig[2] = {0, 0};
+  if (fread(sig, 1, 2, f) != 2) {
+    fclose(f);
+    return false;
+  }
+  rewind(f);
+  bool ok = false;
+  switch (sniff_format(sig)) {
+    case ImgFormat::kPpm:
+      ok = decode_ppm_stream(f, buf, w, h);
+      break;
+#ifdef DEEPOF_HAVE_PNG
+    case ImgFormat::kPng:
+      ok = decode_png_stream(f, buf, w, h);
+      break;
+#endif
+#ifdef DEEPOF_HAVE_JPEG
+    case ImgFormat::kJpeg:
+      ok = decode_jpeg_stream(f, buf, w, h);
+      break;
+#endif
+    default:
+      break;
+  }
+  fclose(f);
+  return ok;
 }
 
 // -------------------------------------------------------- bilinear resize
@@ -317,14 +338,7 @@ int deepof_image_supported(const char* path) {
   size_t n = fread(sig, 1, 2, f);
   fclose(f);
   if (n < 2) return 0;
-  if (sig[0] == 'P' && sig[1] == '6') return 1;
-#ifdef DEEPOF_HAVE_PNG
-  if (sig[0] == 0x89 && sig[1] == 'P') return 1;
-#endif
-#ifdef DEEPOF_HAVE_JPEG
-  if (sig[0] == 0xFF && sig[1] == 0xD8) return 1;
-#endif
-  return 0;
+  return sniff_format(sig) != ImgFormat::kUnsupported ? 1 : 0;
 }
 
 // Decode a batch of images (mixed formats allowed) in parallel into

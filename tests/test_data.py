@@ -208,6 +208,24 @@ def test_synthetic_flow_consistency():
     np.testing.assert_allclose(src_part, tgt_part, atol=1e-4)
 
 
+def test_synthetic_blobs_style_consistency():
+    """The blobs style (unambiguous structure for unsupervised fitting —
+    tools/synthetic_fit.py) keeps the same shift/flow contract."""
+    from deepof_tpu.ops.warp import backward_warp
+
+    cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=2)
+    ds = SyntheticData(cfg, max_shift=3, style="blobs")
+    b = ds.sample_train(2, iteration=0)
+    assert b["source"].min() >= 0.0 and b["source"].max() <= 255.0
+    recon = np.asarray(backward_warp(b["target"], b["flow"]))
+    m = 4
+    np.testing.assert_allclose(recon[:, m:-m, m:-m],
+                               b["source"][:, m:-m, m:-m], atol=1e-3)
+    # deterministic per seed
+    b2 = ds.sample_train(2, iteration=0)
+    np.testing.assert_array_equal(b["source"], b2["source"])
+
+
 def test_build_dataset_dispatch():
     cfg = DataConfig(dataset="synthetic", image_size=(16, 16))
     assert isinstance(build_dataset(cfg), SyntheticData)

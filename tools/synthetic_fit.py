@@ -40,6 +40,7 @@ def main() -> None:
                     help="halve lr every N steps (0 = constant)")
     ap.add_argument("--feature-scale", type=int, default=16)
     ap.add_argument("--max-shift", type=float, default=4.0)
+    ap.add_argument("--style", default="blobs", choices=("noise", "blobs"))
     ap.add_argument("--target-epe", type=float, default=1.0)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -87,7 +88,7 @@ def main() -> None:
     )
     mesh = build_mesh(cfg.mesh)
     ds = SyntheticData(cfg.data, feature_scale=args.feature_scale,
-                       max_shift=args.max_shift)
+                       max_shift=args.max_shift, style=args.style)
     model = build_model("flownet_s")
 
     def schedule(s):
@@ -115,6 +116,7 @@ def main() -> None:
             "lr_decay_every": args.lr_decay_every,
             "feature_scale": args.feature_scale,
             "max_shift": args.max_shift,
+            "style": args.style,
             "zero_flow_epe": round(zero_epe, 4),
             "loss": "default flyingchairs (charbonnier, canonical, "
                     "lambda=1, weights 16/8/4/2/1/1)",
