@@ -300,6 +300,46 @@ def test_edge_aware_photo_matches_oracle(rng):
                           _loss_cfg(edge_aware_photo=True))
 
 
+def test_default_loss_monotone_toward_gt_on_blobs():
+    """Learnability of the DEFAULT FlyingChairs loss on the synthetic
+    blobs data (the tools/synthetic_fit.py proxy): walking the flow from
+    zero toward the ground truth must strictly decrease the pyramid loss,
+    and overshooting in the wrong direction must increase it — i.e. the
+    unsupervised objective's minimizer is the true flow and the descent
+    path from the zero-flow collapse point is open (DESIGN.md "Learning
+    evidence")."""
+    import jax
+
+    from deepof_tpu.core.config import DataConfig
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.flownet_s import FLOW_SCALES
+
+    h = w = 64
+    ds = SyntheticData(DataConfig(dataset="synthetic", image_size=(h, w),
+                                  gt_size=(h, w), batch_size=4),
+                       style="blobs")
+    b = ds.sample_train(4, iteration=0)
+    src = lrn_normalize(preprocess(jnp.asarray(b["source"]), ds.mean))
+    tgt = lrn_normalize(preprocess(jnp.asarray(b["target"]), ds.mean))
+    gt = jnp.asarray(b["flow"])
+    cfg = LossConfig(weights=(16, 8, 4, 2, 1, 1))
+    scales = FLOW_SCALES  # finest-first, matches the trained model
+
+    def loss_at(mult):
+        pyr = []
+        for k, s in enumerate(scales):
+            hk, wk = h >> (k + 1), w >> (k + 1)
+            fk = (jax.image.resize(gt * mult, (4, hk, wk, 2), "bilinear")
+                  * (hk / h) / s)
+            pyr.append((fk, s))
+        total, _, _ = pyramid_loss(pyr, src, tgt, cfg)
+        return float(total)
+
+    path = [loss_at(m) for m in (0.0, 0.25, 0.5, 0.75, 1.0)]
+    assert all(a > b for a, b in zip(path, path[1:])), path
+    assert loss_at(-1.0) > path[0]  # wrong direction is penalized
+
+
 def test_multi_frame_matches_stacked_two_frame(rng):
     """For T=2 the volume loss photometric term must equal the 2-frame one."""
     b, h, w = 1, 12, 16

@@ -77,7 +77,9 @@ def test_two_process_dcn_path(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=600)
+            # generous: 3 cold compile legs per worker on a
+            # potentially contended single-core host
+            out, _ = p.communicate(timeout=1200)
             outs.append(out)
     finally:
         for p in procs:
