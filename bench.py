@@ -8,12 +8,24 @@ the flagship Inception-v3 flow model at the reference's 320x448 input
 The reference publishes no throughput numbers (BASELINE.md); the baseline
 anchor is a self-measured first run stored in `BENCH_BASELINE.json`. When
 absent, vs_baseline = 1.0.
+
+Tunnel resilience: the accelerator is reached through a shared relay
+tunnel that can wedge backend init indefinitely, and a wedged in-process
+init can never be retried (the stuck C++ thread blocks every later
+attempt). So the parent process NEVER initializes the backend itself:
+it probes liveness in throwaway subprocesses, runs the measurement in a
+re-exec'd child (`bench.py --run`), and on any child failure goes back
+to waiting until the wall budget is spent. Every probe/child attempt is
+appended to artifacts/bench_probes.log so a dead-tunnel session leaves
+timestamped evidence of continuous outage.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import threading
 import time
 
@@ -64,12 +76,123 @@ def _watchdog(fn, timeout_s: float, what: str):
 
 
 def _init_devices(timeout_s: float = 240.0):
+    _import_compute()
     return _watchdog(lambda: jax.devices(), timeout_s, "backend init")
 
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
+PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "artifacts", "bench_probes.log")
+
+# os._exit indirection so tests can observe orchestrate()'s terminal
+# paths without killing the pytest process.
+_exit = os._exit
+
+
+def _plog(event: str) -> None:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        # best-effort evidence file — never let it preempt the one JSON
+        # line on stdout (read-only tree, artifacts-path collision, ...)
+        os.makedirs(os.path.dirname(PROBE_LOG), exist_ok=True)
+        with open(PROBE_LOG, "a") as f:
+            f.write(f"{stamp} {event}\n")
+    except OSError:
+        pass
+    print(f"# {stamp} {event}", file=sys.stderr, flush=True)
+
+
+def _tunnel_alive(timeout_s: float = 120.0, fail_fast: bool = False) -> bool:
+    """Backend-init probe in a throwaway subprocess: a hang only costs
+    the child, never this process. rc != 0 is a *deterministic* backend
+    failure, not a hang — with fail_fast it aborts immediately (the
+    interactive perf_probe contract); otherwise it is logged and treated
+    as down so the unattended orchestrator keeps waiting (the error may
+    be tunnel-transient, and its budget is bounded anyway)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _plog(f"probe rc=timeout({timeout_s:.0f}s) DOWN")
+        return False
+    if r.returncode != 0:
+        _plog(f"probe rc={r.returncode} ERROR {r.stderr.strip()[-200:]}")
+        if fail_fast:
+            raise SystemExit(
+                f"backend failed (not a hang): {r.stderr.strip()[-500:]}")
+        return False
+    _plog(f"probe rc=0 UP n_devices={r.stdout.strip()}")
+    return True
+
+
+def orchestrate(deadline_s: float | None = None) -> None:
+    """Wait for a live tunnel window, then measure in a re-exec'd child;
+    retry on any failure until the wall budget runs out. Emits exactly
+    one JSON line either way (the child's on success, an error line from
+    here on exhaustion)."""
+    deadline_s = deadline_s or float(os.environ.get("BENCH_DEADLINE_S", 1500))
+    t_start = time.time()
+    min_child_budget = 300.0
+    attempts, last_err = 0, "no live tunnel window"
+    _plog(f"orchestrate start deadline_s={deadline_s:.0f}")
+    while True:
+        remaining = deadline_s - (time.time() - t_start)
+        if remaining < min_child_budget:
+            break
+        if not _tunnel_alive(min(120.0, max(10.0, remaining - min_child_budget))):
+            time.sleep(min(30.0, max(0.0, remaining - min_child_budget)))
+            continue
+        remaining = deadline_s - (time.time() - t_start)
+        child_budget = max(min(remaining - 30.0, 900.0), min_child_budget)
+        attempts += 1
+        _plog(f"child attempt={attempts} budget={child_budget:.0f}s")
+        env = dict(os.environ, BENCH_DEADLINE_S=str(child_budget - 20.0))
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run"],
+                timeout=child_budget, capture_output=True, text=True, env=env)
+        except subprocess.TimeoutExpired:
+            last_err = f"child attempt {attempts} hit {child_budget:.0f}s"
+            _plog(f"child attempt={attempts} TIMEOUT")
+            continue
+        lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        res = None
+        for ln in lines:
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if cand.get("metric") == METRIC:
+                res = (ln, cand)
+        if res and r.returncode == 0 and res[1].get("value", 0) > 0:
+            _plog(f"child attempt={attempts} OK value={res[1]['value']}")
+            print(res[0], flush=True)
+            _exit(0)
+        last_err = ((res[1].get("error") or f"child rc={r.returncode} "
+                     f"value={res[1].get('value')}") if res else
+                    f"child rc={r.returncode}: {r.stderr.strip()[-200:]}")
+        _plog(f"child attempt={attempts} FAIL {last_err}")
+    _plog(f"orchestrate exhausted attempts={attempts} last={last_err}")
+    emit(0.0, 0.0, error=f"{last_err} (after {attempts} measurement "
+         f"attempts in {deadline_s:.0f}s; probe log: artifacts/"
+         "bench_probes.log)")
+    _exit(1)
+
+
+# Third-party imports are deferred so the orchestrating parent stays
+# stdlib-only: even *importing* jax runs the container's sitecustomize
+# relay probe, and a hang there would bypass the whole tunnel-defuse
+# design (no probe log, no JSON line). Only the --run child imports jax.
+jax = jnp = np = None
+
+
+def _import_compute() -> None:
+    global jax, jnp, np
+    if jax is None:
+        import jax as _jax
+        import jax.numpy as _jnp
+        import numpy as _np
+        jax, jnp, np = _jax, _jnp, _np
 
 
 def calibrate(n: int = 4096, reps: int = 10) -> dict:
@@ -77,6 +200,7 @@ def calibrate(n: int = 4096, reps: int = 10) -> dict:
     headline number: the chip is reached through a shared tunnel whose
     throughput and latency swing over minutes (observed 30-130 TFLOP/s
     and 0.1-66 ms RTT on the same binary)."""
+    _import_compute()
     a = jnp.ones((n, n), jnp.bfloat16)
 
     @jax.jit
@@ -110,6 +234,7 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     decomposition there always measures the same config as the headline.
 
     Returns (cfg, mesh, ds, model, state, step, sharded_batch)."""
+    _import_compute()
     from deepof_tpu.core.config import (
         DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
     from deepof_tpu.data.datasets import SyntheticData
@@ -214,17 +339,20 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     return res
 
 
-def main(deadline_s: float = 1500.0) -> None:
-    """Run the whole bench under a wall-clock watchdog. The init watchdog
-    alone is not enough: a wedged relay can also hang the *remote compile*
-    (observed), and a stuck C++ compile thread cannot be interrupted — so
-    the final line is printed from the main thread and the process exits
-    with os._exit, skipping atexit hooks a dead tunnel would block."""
+def main(deadline_s: float | None = None) -> None:
+    """Child mode: run the bench under a wall-clock watchdog. The init
+    watchdog alone is not enough: a wedged relay can also hang the
+    *remote compile* (observed), and a stuck C++ compile thread cannot be
+    interrupted — so the final line is printed from the main thread and
+    the process exits with os._exit, skipping atexit hooks a dead tunnel
+    would block. The orchestrating parent re-execs this mode per attempt,
+    so even a wedge this watchdog cannot unwind only costs one attempt."""
+    deadline_s = deadline_s or float(os.environ.get("BENCH_DEADLINE_S", 1500))
     try:
         res = _watchdog(bench, deadline_s, "bench")
     except TimeoutError as e:
         emit(0.0, 0.0, error=str(e))
-        os._exit(1)
+        _exit(1)
     vs = 1.0
     try:
         baseline_path = os.path.join(os.path.dirname(__file__),
@@ -239,8 +367,11 @@ def main(deadline_s: float = 1500.0) -> None:
                                  "model_tflops", "mfu_nominal",
                                  "mfu_vs_matmul") if k in res}
     emit(res["pairs_per_sec_per_chip"], vs, **extra)
-    os._exit(0)
+    _exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        main()
+    else:
+        orchestrate()

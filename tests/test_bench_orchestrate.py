@@ -1,0 +1,130 @@
+"""Orchestrator logic of bench.py: relay, retry, exhaustion.
+
+The measurement itself is TPU-gated; these tests pin the tunnel-
+resilience control flow (VERDICT r02 item 1) with stubbed probes,
+children, and clock — no backend touched.
+"""
+
+from __future__ import annotations
+
+import json
+import types
+
+import pytest
+
+import bench
+
+
+class _Clock:
+    """Deterministic stand-in for bench.time (orchestrate only calls
+    time/sleep/strftime/gmtime)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+    def strftime(self, fmt, tm=None):
+        return "T"
+
+    def gmtime(self):
+        return None
+
+
+def _wire(monkeypatch, tmp_path, alive, run):
+    clock = _Clock()
+    monkeypatch.setattr(bench, "time", clock)
+    monkeypatch.setattr(bench, "PROBE_LOG", str(tmp_path / "probes.log"))
+    monkeypatch.setattr(bench, "_tunnel_alive",
+                        lambda timeout_s=120.0: clock.sleep(5) or alive())
+    monkeypatch.setattr(
+        bench, "subprocess",
+        types.SimpleNamespace(run=run,
+                              TimeoutExpired=bench.subprocess.TimeoutExpired))
+    monkeypatch.setattr(bench, "_exit",
+                        lambda code: (_ for _ in ()).throw(SystemExit(code)))
+    return clock
+
+
+def _json_lines(out):
+    return [json.loads(ln) for ln in out.splitlines() if ln.startswith("{")]
+
+
+def test_relays_child_success_line_verbatim(monkeypatch, capsys, tmp_path):
+    good = json.dumps({"metric": bench.METRIC, "value": 251.3,
+                       "unit": bench.UNIT, "vs_baseline": 1.01,
+                       "mfu_nominal": 0.11})
+
+    def run(cmd, timeout, capture_output, text, env):
+        return types.SimpleNamespace(
+            returncode=0, stdout="noise\n" + good + "\n", stderr="")
+
+    clock = _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=1500)
+    assert e.value.code == 0
+    lines = _json_lines(capsys.readouterr().out)
+    assert lines == [json.loads(good)]
+    assert clock.t < 1500
+
+
+def test_retries_after_failed_child_until_success(monkeypatch, capsys,
+                                                  tmp_path):
+    calls = {"n": 0}
+    good = json.dumps({"metric": bench.METRIC, "value": 300.0,
+                       "unit": bench.UNIT, "vs_baseline": 1.2})
+
+    def run(cmd, timeout, capture_output, text, env):
+        calls["n"] += 1
+        monkeypatch.setattr(bench.time, "t", bench.time.t + 60)
+        if calls["n"] < 3:  # two wedged windows, then a clean one
+            raise bench.subprocess.TimeoutExpired(cmd, timeout)
+        return types.SimpleNamespace(returncode=0, stdout=good + "\n",
+                                     stderr="")
+
+    _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=1500)
+    assert e.value.code == 0
+    assert calls["n"] == 3
+    assert _json_lines(capsys.readouterr().out) == [json.loads(good)]
+
+
+def test_exhaustion_emits_single_error_line(monkeypatch, capsys, tmp_path):
+    def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
+        raise AssertionError("child must not run when tunnel is down")
+
+    _wire(monkeypatch, tmp_path, lambda: False, run)
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=700)
+    assert e.value.code == 1
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1
+    assert lines[0]["value"] == 0.0 and "attempts" in lines[0]["error"]
+    # timestamped outage evidence was written
+    assert "exhausted" in open(tmp_path / "probes.log").read()
+
+
+def test_child_error_line_is_not_relayed_as_success(monkeypatch, capsys,
+                                                    tmp_path):
+    bad = json.dumps({"metric": bench.METRIC, "value": 0.0,
+                      "unit": bench.UNIT, "vs_baseline": 0.0,
+                      "error": "backend init exceeded 240s"})
+
+    def run(cmd, timeout, capture_output, text, env):
+        monkeypatch.setattr(bench.time, "t", bench.time.t + 200)
+        return types.SimpleNamespace(returncode=1, stdout=bad + "\n",
+                                     stderr="")
+
+    _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=900)
+    assert e.value.code == 1
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1
+    assert lines[0]["value"] == 0.0
+    assert "backend init exceeded" in lines[0]["error"]

@@ -25,35 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench as bench_mod  # noqa: E402
 
 
-def _tunnel_alive(timeout_s: float) -> bool:
-    """Probe backend init in a throwaway subprocess.
-
-    A wedged backend init leaves an uninterruptible stuck C++ thread in the
-    probing process (bench.py `_watchdog` contract), so retrying
-    `jax.devices()` in THIS process after one timeout would block behind
-    the first stuck attempt forever. Each retry therefore re-execs a fresh
-    interpreter; JAX is only initialized in the main process once a
-    subprocess has seen the tunnel up.
-    """
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices())"],
-            timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return False
-    if r.returncode != 0:
-        raise SystemExit(
-            f"backend failed (not a hang): {r.stderr.strip()[-500:]}")
-    print("tunnel probe:", r.stdout.strip(), flush=True)
-    return True
-
-
 def wait_for_tunnel(max_s: float) -> None:
+    # Probe backend init in throwaway subprocesses (bench._tunnel_alive):
+    # a wedged init leaves an uninterruptible stuck C++ thread, so each
+    # retry re-execs a fresh interpreter; JAX is only initialized in the
+    # main process once a subprocess has seen the tunnel up.
     deadline = time.time() + max_s
     while True:
-        if _tunnel_alive(timeout_s=120):
+        if bench_mod._tunnel_alive(timeout_s=120, fail_fast=True):
             try:
                 # the tunnel can wedge between the subprocess probe and
                 # this main-process init; treat that as "still down" (the

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Tunnel liveness watcher. Probes backend init in throwaway subprocesses
+# (an in-process wedged init can never be retried — see DESIGN.md
+# "Benchmark honesty") and appends a timestamped record per attempt, so a
+# round with the tunnel down all session leaves checked-in evidence of
+# continuous outage (VERDICT r02 item 1). On success it touches
+# /tmp/tunnel_up and keeps probing at a slower cadence so the log also
+# records when a live window closes. /tmp/tunnel_up is a session-local
+# signal for the OPERATOR (poll it between CPU tasks to know when the
+# TPU-gated queue — perf_probe, synthetic_fit — can run); no repo code
+# reads it, and it is only meaningful while this watcher is running.
+LOG="${1:-/root/repo/artifacts/tunnel_probe_r03.log}"
+INTERVAL="${2:-300}"
+mkdir -p "$(dirname "$LOG")"
+while :; do
+    t0=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    out=$(timeout 120 python -c "import jax; print(jax.devices())" 2>&1)
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        echo "$t0 rc=0 UP $(echo "$out" | tail -1)" >> "$LOG"
+        touch /tmp/tunnel_up
+        sleep 600
+    else
+        echo "$t0 rc=$rc DOWN" >> "$LOG"
+        rm -f /tmp/tunnel_up
+        sleep "$INTERVAL"
+    fi
+done
