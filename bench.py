@@ -172,6 +172,10 @@ def orchestrate(deadline_s: float | None = None) -> None:
                      f"value={res[1].get('value')}") if res else
                     f"child rc={r.returncode}: {r.stderr.strip()[-200:]}")
         _plog(f"child attempt={attempts} FAIL {last_err}")
+        # backoff: a deterministically fast-failing child would otherwise
+        # hammer the shared relay with probe+re-exec cycles all budget
+        time.sleep(min(20.0, max(0.0, deadline_s - (time.time() - t_start)
+                                 - min_child_budget)))
     _plog(f"orchestrate exhausted attempts={attempts} last={last_err}")
     emit(0.0, 0.0, error=f"{last_err} (after {attempts} measurement "
          f"attempts in {deadline_s:.0f}s; probe log: artifacts/"

@@ -52,7 +52,10 @@ class LossConfig:
     # multiplies both smoothness terms (edges may move freely). Charbonnier
     # photometric, two-frame loss only (multi-frame volume configs are
     # rejected — the reference feature exists only in the vgg 2-frame
-    # variant).
+    # variant). NOTE: the reference only ever pairs this with
+    # smoothness='depthwise' (the vgg variant's shape); combining it with
+    # smoothness='canonical' is accepted as an EXTENSION beyond the
+    # reference — strict-parity configs should set both together.
     edge_aware_photo: bool = False
     # Smooth the *scaled* flow (canonical `flyingChairsWrapFlow.py:785,854`)
     # vs the raw head output (gen-1 `version1/model/warpflow.py:37,133`).
@@ -119,6 +122,9 @@ class DataConfig:
     crop_size: tuple[int, int] | None = None
     prefetch: int = 2
     cache_decoded: bool = True
+    # byte budget of the decoded-image LRU (host RAM); 4 GiB pins all of
+    # FlyingChairs at 320x448 with room to spare
+    cache_bytes: int = 4 << 30
 
 
 @dataclass(frozen=True)

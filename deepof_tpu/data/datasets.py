@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import os
 import re
+import warnings
 from typing import Protocol
 
 import numpy as np
@@ -49,6 +50,20 @@ DATASET_MEANS = {
     "ucf101": UCF101_MEAN,
     "synthetic": (0.0, 0.0, 0.0),
 }
+
+
+_warned_native_fallback = False
+
+
+def _warn_native_fallback(err: Exception) -> None:
+    """One warning per process: native batch IO failed (mixed formats,
+    corrupt file, ...) and the affected batches take the python path."""
+    global _warned_native_fallback
+    if not _warned_native_fallback:
+        _warned_native_fallback = True
+        warnings.warn(
+            f"native IO batch failed ({err}); affected batches fall back "
+            "to the python decode path", RuntimeWarning, stacklevel=3)
 
 
 def _imread_bgr(path: str) -> np.ndarray:
@@ -143,7 +158,8 @@ class FlyingChairsData:
         self.val_ids = [i for i, m in zip(ids, markers) if m == 2]
         self.num_train, self.num_val = len(self.train_ids), len(self.val_ids)
         self._root = root
-        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr,
+                                    max_bytes=cfg.cache_bytes)
         self._flo_hw: tuple[int, int] | None = None  # native path probe
 
     def _load(self, sid: str, with_flow: bool) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
@@ -179,13 +195,19 @@ class FlyingChairsData:
         if self.cfg.cache_decoded or not native.available():
             return None
         paths = [os.path.join(self._root, s) for s in sids]
-        if self._flo_hw is None:
-            self._flo_hw = native.flo_dims(paths[0] + "_flow.flo")
-        imgs = native.decode_ppm_batch(
-            [p + sfx for sfx in ("_img1.ppm", "_img2.ppm") for p in paths],
-            self.cfg.image_size)
-        flows = native.read_flo_batch([p + "_flow.flo" for p in paths],
-                                      self._flo_hw)
+        try:
+            if self._flo_hw is None:
+                self._flo_hw = native.flo_dims(paths[0] + "_flow.flo")
+            imgs = native.decode_ppm_batch(
+                [p + sfx for sfx in ("_img1.ppm", "_img2.ppm") for p in paths],
+                self.cfg.image_size)
+            flows = native.read_flo_batch([p + "_flow.flo" for p in paths],
+                                          self._flo_hw)
+        except (OSError, RuntimeError) as e:
+            # a later-unsupported/corrupt file must degrade to the python
+            # path for this batch, not fail it (ADVICE r02)
+            _warn_native_fallback(e)
+            return None
         n = len(paths)
         return {"source": imgs[:n], "target": imgs[n:], "flow": flows}
 
@@ -251,7 +273,8 @@ class SintelData:
         self.val_idx = val
         self.train_idx = [i for i in range(len(self.windows)) if i not in set(self.val_idx)]
         self.num_train, self.num_val = len(self.train_idx), len(self.val_idx)
-        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr,
+                                    max_bytes=cfg.cache_bytes)
         self._flo_hw: tuple[int, int] | None = None  # native path probe
         self._native_ok: bool | None = None  # codec probe, once
 
@@ -294,7 +317,19 @@ class SintelData:
         t = self.t
         b = len(idxs)
         h, w = self.cfg.image_size
-        imgs = native.decode_image_batch(frame_paths, (h, w))
+        # all native reads happen BEFORE any crop_rng draw, so a failed
+        # batch falls back to `_window` with the rng stream intact (same
+        # draw order as the python path)
+        try:
+            imgs = native.decode_image_batch(frame_paths, (h, w))
+            flow_paths = [p for i in idxs for p in self.flow_windows[i]]
+            if self._flo_hw is None:
+                self._flo_hw = native.flo_dims(flow_paths[0])
+            fh, fw = self._flo_hw
+            flo = native.read_flo_batch(flow_paths, (fh, fw))
+        except (OSError, RuntimeError) as e:
+            _warn_native_fallback(e)
+            return None
         # channel-stack each window's T frames (frame-major, BGR within)
         vols = (imgs.reshape(b, t, h, w, 3).transpose(0, 2, 3, 1, 4)
                 .reshape(b, h, w, 3 * t))
@@ -306,11 +341,6 @@ class SintelData:
                 x = crop_rng.randint(0, w - cw + 1)
                 out[k] = vols[k, y : y + ch, x : x + cw]
             vols = out
-        flow_paths = [p for i in idxs for p in self.flow_windows[i]]
-        if self._flo_hw is None:
-            self._flo_hw = native.flo_dims(flow_paths[0])
-        fh, fw = self._flo_hw
-        flo = native.read_flo_batch(flow_paths, (fh, fw))
         flows = (flo.reshape(b, t - 1, fh, fw, 2).transpose(0, 2, 3, 1, 4)
                  .reshape(b, fh, fw, 2 * (t - 1)))
         return {"volume": vols, "flow": flows}
@@ -359,7 +389,8 @@ class UCF101Data:
                 ).append(frames)
         self.num_train = sum(len(v) for v in self.train_clips.values())
         self.num_val = sum(len(v) for v in self.val_clips.values())
-        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr)
+        self._cache = _DecodedCache(cfg.cache_decoded, _imread_bgr,
+                                    max_bytes=cfg.cache_bytes)
         self._native_ok: bool | None = None  # codec probe, once
 
     def _batch_from(self, clips: dict[int, list[list[str]]], class_ids, rng):
@@ -390,7 +421,10 @@ class UCF101Data:
                 self._native_ok = (native.available()
                                    and native.image_supported(paths[0]))
             if self._native_ok:
-                return native.decode_image_batch(paths, self.cfg.image_size)
+                try:
+                    return native.decode_image_batch(paths, self.cfg.image_size)
+                except (OSError, RuntimeError) as e:
+                    _warn_native_fallback(e)
         return np.stack([
             _resize(self._cache(p), self.cfg.image_size) for p in paths
         ]).astype(np.float32)

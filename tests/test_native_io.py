@@ -190,3 +190,42 @@ def test_ucf101_native_batch_matches_python(tmp_path, rng):
     # a few LSBs between libjpeg variants
     assert np.abs(bn["source"] - bp["source"]).mean() < 2.0
     assert np.abs(bn["target"] - bp["target"]).mean() < 2.0
+
+
+def test_corrupt_file_mid_dataset_falls_back_to_python(chairs_dir):
+    # A file the native codecs cannot decode (BMP content behind a .ppm
+    # name — cv2 sniffs content and reads it fine) must degrade the BATCH
+    # to the cv2 path, not raise out of the loader (ADVICE r02): same
+    # content as the pure python batch, one RuntimeWarning.
+    img = cv2.imread(str(chairs_dir / "00002_img1.ppm"), cv2.IMREAD_COLOR)
+    ok, buf = cv2.imencode(".bmp", img)
+    assert ok
+    (chairs_dir / "00002_img1.ppm").write_bytes(buf.tobytes())
+    cfg = DataConfig(dataset="flyingchairs", data_path=str(chairs_dir),
+                     image_size=(64, 96), gt_size=(64, 96), batch_size=2,
+                     cache_decoded=False)
+    import deepof_tpu.data.datasets as dsm
+    dsm._warned_native_fallback = False
+    ds = FlyingChairsData(cfg)
+    with pytest.warns(RuntimeWarning, match="native IO batch failed"):
+        b = ds.sample_train(2, iteration=0)  # batch = 00001, 00002
+    ds2 = FlyingChairsData(cfg)
+    ds2._native_batch = lambda sids: None
+    b_py = ds2.sample_train(2, iteration=0)
+    np.testing.assert_allclose(b["source"], b_py["source"], atol=0.01)
+    np.testing.assert_array_equal(b["flow"], b_py["flow"])
+
+
+def test_single_image_entrypoints_survive_hostile_header(tmp_path):
+    # Exported single-image C functions are callable straight from ctypes;
+    # a 64k x 64k header must fail the call (rc != 0), not unwind a
+    # bad_alloc across the C ABI (ADVICE r02).
+    import ctypes
+
+    bad = tmp_path / "huge.ppm"
+    bad.write_bytes(b"P6\n65536 65536\n255\n")
+    lib = native._load()
+    out = np.empty((8, 8, 3), np.float32)
+    ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    assert lib.deepof_decode_ppm(str(bad).encode(), ptr, 8, 8) != 0
+    assert lib.deepof_decode_image(str(bad).encode(), ptr, 8, 8) != 0

@@ -1,0 +1,90 @@
+"""Host IO-path benchmark: native C++ batch decode vs cv2 python loop.
+
+Quantifies the input-pipeline claim in DESIGN.md ("per-step host decode
+starves the chip") with numbers from THIS host: synthetic FlyingChairs-
+shaped PPMs (384x512 -> 320x448) and Sintel-shaped PNGs (436x1024 native)
+are generated in /tmp, then both decode paths are timed end-to-end on
+identical batches (native includes its thread-pool parallelism — that is
+the point: one call decodes the batch off the GIL).
+
+Run: python tools/io_bench.py [--batch 16] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import cv2  # noqa: E402
+
+from deepof_tpu import native  # noqa: E402
+from deepof_tpu.data.datasets import _imread_bgr, _resize  # noqa: E402
+
+
+def _stage(root: str, kind: str, n: int) -> list[str]:
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(n):
+        if kind == "chairs_ppm":
+            img = rng.randint(0, 255, (384, 512, 3), dtype=np.uint8)
+            p = os.path.join(root, f"c{i:03d}.ppm")
+            with open(p, "wb") as f:
+                f.write(b"P6\n512 384\n255\n")
+                f.write(img[..., ::-1].tobytes())
+        else:  # sintel_png
+            img = rng.randint(0, 255, (436, 1024, 3), dtype=np.uint8)
+            p = os.path.join(root, f"s{i:03d}.png")
+            cv2.imwrite(p, img)
+        paths.append(p)
+    return paths
+
+
+def _time(fn, reps: int) -> float:
+    fn()  # warm (page cache, pool spin-up)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    if not native.available():
+        raise SystemExit("native IO unavailable (no toolchain)")
+
+    with tempfile.TemporaryDirectory() as root:
+        for kind, size in [("chairs_ppm", (320, 448)),
+                           ("sintel_png", (436, 1024))]:
+            paths = _stage(root, kind, args.batch)
+
+            def run_native():
+                return native.decode_image_batch(paths, size)
+
+            def run_cv2():
+                return np.stack(
+                    [_resize(_imread_bgr(p), size) for p in paths]
+                ).astype(np.float32)
+
+            tn = _time(run_native, args.reps)
+            tp = _time(run_cv2, args.reps)
+            # parity guard: same tensors (1 LSB for codec rounding)
+            np.testing.assert_allclose(run_native(), run_cv2(), atol=1.0)
+            print(f"{kind}: batch={args.batch} native={args.batch / tn:7.1f} "
+                  f"img/s  cv2={args.batch / tp:7.1f} img/s  "
+                  f"speedup={tp / tn:4.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()
