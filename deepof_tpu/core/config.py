@@ -122,8 +122,11 @@ class DataConfig:
     crop_size: tuple[int, int] | None = None
     prefetch: int = 2
     cache_decoded: bool = True
-    # byte budget of the decoded-image LRU (host RAM); 4 GiB pins all of
-    # FlyingChairs at 320x448 with room to spare
+    # byte budget of the decoded-image LRU (host RAM). The cache stores
+    # NATIVE-resolution decoded images (resize happens per batch), so the
+    # full 22,872-pair FlyingChairs set (~25 GiB at 384x512) does NOT fit
+    # the default — use streaming mode (cache_decoded=False) there; 4 GiB
+    # pins Sintel (~1k frames/pass) and the val splits comfortably.
     cache_bytes: int = 4 << 30
 
 

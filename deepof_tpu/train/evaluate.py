@@ -67,6 +67,13 @@ def dump_visuals(out_dir: str, tag: str, flow: np.ndarray,
             cv2.imwrite(os.path.join(out_dir, f"{tag}_s{i}_recon.png"), img)
 
 
+def _wmean(pairs: list[tuple[float, int]]) -> float:
+    """Row-weighted mean of per-batch (value, valid_rows) pairs — the
+    full-split eval convention shared by evaluate_aee/evaluate_ucf101."""
+    vals, ws = zip(*pairs)
+    return float(np.average(vals, weights=ws))
+
+
 def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
                  dump_dir: str | None = None) -> dict[str, float]:
     """Run the AEE protocol over the full validation split.
@@ -108,15 +115,11 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
             dump_visuals(dump_dir, f"val{bid}", pred,
                          out.get("recon"), gt)
 
-    def wmean(pairs):
-        vals, ws = zip(*pairs)
-        return float(np.average(vals, weights=ws))
-
     # flow-statistics report (reference `flyingChairsTrain.py:298-312`)
     return {
-        "aee": wmean(epes),
-        "aae": wmean(aaes),
-        "val_loss": wmean(totals),
+        "aee": _wmean(epes),
+        "aae": _wmean(aaes),
+        "val_loss": _wmean(totals),
         "pred_abs_mean": p_sum / max(p_n, 1),
         "pred_abs_max": p_max,
         "gt_abs_mean": g_sum / max(g_n, 1),
@@ -145,8 +148,7 @@ def evaluate_ucf101(eval_fn, params, dataset, cfg: ExperimentConfig,
         # padded remainder batch doesn't over-weight its wrapped head
         # duplicates (same convention as evaluate_aee's wmean)
         totals.append((float(out["total"]), valid))
-    vals, ws = zip(*totals)
     return {
         "accuracy": correct / max(seen, 1),
-        "val_loss": float(np.average(vals, weights=ws)),
+        "val_loss": _wmean(totals),
     }
