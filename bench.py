@@ -233,9 +233,13 @@ def calibrate(n: int = 4096, reps: int = 10) -> dict:
 
 
 def headline_setup(model_name: str = "inception_v3", batch: int = 16,
-                   image_size=(320, 448)):
+                   image_size=(320, 448), steps_per_call: int = 1):
     """The headline workload, shared with tools/perf_probe.py so the
     decomposition there always measures the same config as the headline.
+
+    With steps_per_call = K > 1 the returned step takes K stacked batches
+    ([K, B, ...]) and the returned sharded batch is stacked accordingly
+    (the perf_probe dispatch-amortization sweep).
 
     Returns (cfg, mesh, ds, model, state, step, sharded_batch)."""
     _import_compute()
@@ -243,7 +247,8 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
         DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
     from deepof_tpu.data.datasets import SyntheticData
     from deepof_tpu.models.registry import build_model
-    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+    from deepof_tpu.parallel.mesh import (
+        batch_sharding, build_mesh, stacked_batch_sharding)
     from deepof_tpu.train.state import create_train_state, make_optimizer
     from deepof_tpu.train.step import make_train_step
 
@@ -255,7 +260,8 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
         optim=OptimConfig(learning_rate=1.6e-5),
         data=DataConfig(dataset="synthetic", image_size=(h, w), gt_size=(h, w),
                         batch_size=batch),
-        train=TrainConfig(seed=0, compute_dtype="bfloat16"),
+        train=TrainConfig(seed=0, compute_dtype="bfloat16",
+                          steps_per_call=steps_per_call),
     )
     mesh = build_mesh(cfg.mesh)
     model = build_model(cfg.model, dtype=jnp.bfloat16)
@@ -263,7 +269,13 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     state = create_train_state(model, jnp.zeros((batch, h, w, 6)), tx, seed=0)
     ds = SyntheticData(cfg.data)
     step = make_train_step(model, cfg, ds.mean, mesh)
-    b = jax.device_put(ds.sample_train(batch, iteration=0), batch_sharding(mesh))
+    one = ds.sample_train(batch, iteration=0)
+    if steps_per_call > 1:
+        b = jax.device_put({k: np.stack([v] * steps_per_call)
+                            for k, v in one.items()},
+                           stacked_batch_sharding(mesh))
+    else:
+        b = jax.device_put(one, batch_sharding(mesh))
     return cfg, mesh, ds, model, state, step, b
 
 
@@ -272,6 +284,30 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
 # absolute MFU figure; `mfu_vs_matmul` (vs the concurrently measured raw
 # matmul rate) is the tunnel-condition-independent one.
 NOMINAL_BF16_TFLOPS = 197.0
+
+
+def time_train_step(step, state, b, steps: int = 10, windows: int = 3,
+                    warmup: int = 1, metrics_key: str = "total"):
+    """Honest best-of-windows timing of a (state, batch) train step.
+
+    Ends every window by FETCHING the loss value — it transitively
+    depends on every dispatched step, so it cannot materialize early
+    (unlike `block_until_ready`; DESIGN.md "Benchmark honesty"). The
+    donated state threads the dependency chain across calls. Returns
+    (seconds per CALL, final state, fetched metrics value). The single
+    timing idiom shared by bench() and tools/perf_probe.py."""
+    _import_compute()
+    for _ in range(max(warmup, 1)):  # >=1: m must exist for the fetch
+        state, m = step(state, b)
+    val = jax.device_get(m[metrics_key])
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, b)
+        val = jax.device_get(m[metrics_key])
+        best = min(best, time.perf_counter() - t0)
+    return best / steps, state, val
 
 
 def step_flops(step, state, b) -> float | None:
@@ -296,31 +332,13 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     cfg, mesh, ds, model, state, step, b = headline_setup(
         model_name, batch, image_size)
 
-    for _ in range(warmup):
-        state, metrics = step(state, b)
-    total = float(jax.device_get(metrics["total"]))
-    assert np.isfinite(total)
-
-    # Timing honesty: end every window by FETCHING the final loss value.
-    # The value transitively depends on every dispatched step, so it cannot
-    # materialize early — unlike `block_until_ready`, whose readiness event
-    # has been observed to fire before execution completes on the tunneled
-    # backend (apparent >1 PFLOP/s on a ~200 TFLOP/s chip). Best of several
-    # windows then measures the code, not the neighbors on a shared chip.
-    best_dt = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, b)
-        total = float(jax.device_get(metrics["total"]))
-        best_dt = min(best_dt, time.perf_counter() - t0)
-    dt = best_dt
-
-    pairs_per_sec = steps * batch / dt
+    per_step, state, total = time_train_step(
+        step, state, b, steps=steps, windows=windows, warmup=warmup)
+    pairs_per_sec = batch / per_step
     per_chip = pairs_per_sec / n_chips
-    assert np.isfinite(total)
+    assert np.isfinite(total).all(), total
     res = {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
-           "n_chips": n_chips, "batch": batch, "steps_per_sec": steps / dt,
+           "n_chips": n_chips, "batch": batch, "steps_per_sec": 1.0 / per_step,
            **calibrate()}
     # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
     # nominal chip peak and the concurrently measured matmul rate (the

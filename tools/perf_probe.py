@@ -3,14 +3,18 @@
 Honest (value-fetch) timings; see DESIGN.md "Benchmark honesty" for why
 `block_until_ready` is not trusted on this transport. Usage:
 
-    python tools/perf_probe.py            # waits for the tunnel, runs all
-    python tools/perf_probe.py --no-wait  # fail fast if tunnel is down
+    python tools/perf_probe.py                 # waits for tunnel, runs all
+    python tools/perf_probe.py --no-wait       # fail fast if tunnel down
+    python tools/perf_probe.py --only warp,decomp   # named sections
 
-Sections:
-  1. calibration (raw matmul TFLOP/s + RTT)
-  2. warp XLA vs Pallas at coarse/mid levels, fwd and grad
-  3. Inception-v3 train-step decomposition (fwd / fwd+loss / +bwd / full)
-  4. bench.py headline
+Sections (in the order a short tunnel window should spend them):
+  calib    raw matmul TFLOP/s + RTT (tunnel-condition context)
+  decomp   Inception-v3 train-step decomposition (fwd / fwd+loss /
+           +bwd / full step, and the pyramid-loss/warp share)
+  warp     XLA vs Pallas warp at coarse/mid levels, fwd and grad
+  batch    batch-size throughput curve (16/32/64/96)
+  spc      steps_per_call sweep (1/2/4/8): dispatch+RTT amortization
+  headline bench.py headline (value + MFU fields)
 """
 
 from __future__ import annotations
@@ -80,23 +84,23 @@ def timeit(name, fn, *args, steps=10, windows=3, items=None):
     return per
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--no-wait", action="store_true")
-    ap.add_argument("--wait-s", type=float, default=7200)
-    args = ap.parse_args()
-    wait_for_tunnel(0 if args.no_wait else args.wait_s)
+def _time_full_step(step, state, b, steps=10, windows=3):
+    """Per-call train-step timing via the ONE shared honesty-critical
+    idiom (bench.time_train_step)."""
+    per, state, _ = bench_mod.time_train_step(step, state, b, steps=steps,
+                                              windows=windows)
+    return per, state
 
-    import jax
-    import jax.numpy as jnp
 
-    from deepof_tpu.losses.pyramid import lrn_normalize, preprocess, pyramid_loss
-    from deepof_tpu.ops.warp import backward_warp
-    from deepof_tpu.train.step import model_losses
-
+def sec_calib() -> None:
     print("calib:", bench_mod.calibrate(), flush=True)
 
-    # ---- warp: XLA vs Pallas (coarse + mid levels)
+
+def sec_warp() -> None:
+    import jax
+
+    from deepof_tpu.ops.warp import backward_warp
+
     key = jax.random.PRNGKey(0)
     for (h, w) in [(40, 56), (80, 112)]:
         img = jax.random.uniform(key, (16, h, w, 3))
@@ -109,7 +113,14 @@ def main() -> None:
                 lambda q: backward_warp(i, q, impl=impl).sum())(fl).sum())
             timeit(f"warp grad {impl} {h}x{w}", g, img, flow)
 
-    # ---- inception step decomposition — the EXACT headline workload
+
+def sec_decomp() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.losses.pyramid import lrn_normalize, preprocess, pyramid_loss
+    from deepof_tpu.train.step import model_losses
+
     cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup()
     B = cfg.data.batch_size
 
@@ -130,17 +141,9 @@ def main() -> None:
                                compute_dtype=jnp.bfloat16)[0])(p)[0])
     timeit("inception fwd+loss+bwd", fwd_loss_grad, state.params, b, items=B)
 
-    state, m = step(state, b)
-    float(jax.device_get(m["total"]))
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(10):
-            state, m = step(state, b)
-        float(jax.device_get(m["total"]))
-        best = min(best, time.perf_counter() - t0)
-    print(f"{'full train step':44s} {best/10*1e3:8.2f} ms  "
-          f"{B/(best/10):9.1f} items/s", flush=True)
+    per, state = _time_full_step(step, state, b)
+    print(f"{'full train step':44s} {per*1e3:8.2f} ms  "
+          f"{B/per:9.1f} items/s", flush=True)
 
     flows = jax.jit(lambda p, x: model.apply({"params": p}, x))(state.params, pair)
     flows = [f.astype(jnp.float32) for f in flows]
@@ -155,10 +158,66 @@ def main() -> None:
     timeit("pyramid loss grad (wrt flows)", loss_grad_alone, flows, li, lo,
            items=B)
 
-    # ---- headline
+
+def sec_batch() -> None:
+    # throughput curve: same model, growing batch; is the chip compute-
+    # bound (flat items/s => yes) or dispatch/HBM-bound (rising)?
+    for batch in (16, 32, 64, 96):
+        cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup(
+            batch=batch)
+        per, _ = _time_full_step(step, state, b, windows=2)
+        print(f"{'batch sweep b=%d' % batch:44s} {per*1e3:8.2f} ms  "
+              f"{batch/per:9.1f} items/s", flush=True)
+
+
+def sec_spc() -> None:
+    # steps_per_call sweep: K optimizer steps per dispatch; the gap
+    # between K=1 and K->8 per-step times IS the per-dispatch host/
+    # transport overhead (DESIGN.md "Benchmark honesty").
+    for k in (1, 2, 4, 8):
+        cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup(
+            steps_per_call=k)
+        per_call, _ = _time_full_step(step, state, b, steps=6, windows=2)
+        B = cfg.data.batch_size
+        print(f"{'steps_per_call K=%d' % k:44s} {per_call/k*1e3:8.2f} "
+              f"ms/step  {k*B/per_call:9.1f} items/s", flush=True)
+
+
+def sec_headline() -> None:
     res = bench_mod.bench()
     print("bench:", {k: round(v, 2) if isinstance(v, float) else v
                      for k, v in res.items()}, flush=True)
+
+
+SECTIONS = {
+    "calib": sec_calib,
+    "decomp": sec_decomp,
+    "warp": sec_warp,
+    "batch": sec_batch,
+    "spc": sec_spc,
+    "headline": sec_headline,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-wait", action="store_true")
+    ap.add_argument("--wait-s", type=float, default=7200)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated section names (default: all, in "
+                         f"order {','.join(SECTIONS)})")
+    args = ap.parse_args()
+    names = list(SECTIONS) if not args.only else args.only.split(",")
+    unknown = [n for n in names if n not in SECTIONS]
+    if unknown:
+        raise SystemExit(f"unknown sections {unknown}; have {list(SECTIONS)}")
+    wait_for_tunnel(0 if args.no_wait else args.wait_s)
+    for n in names:
+        print(f"--- section {n}", flush=True)
+        t0 = time.perf_counter()
+        SECTIONS[n]()
+        print(f"--- section {n} done in {time.perf_counter() - t0:.1f}s",
+              flush=True)
 
 
 if __name__ == "__main__":

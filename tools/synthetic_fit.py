@@ -123,24 +123,54 @@ def main() -> None:
             "eval": "pr1 x2, AEE at GT res, held-out synthetic val",
         }) + "\n")
         rng = np.random.RandomState(0)
-        for s in range(args.steps + 1):
-            if s % args.eval_every == 0:
-                res = evaluate_aee(eval_fn, state.params, ds, cfg)
-                rec = {"kind": "eval", "step": s,
-                       "aee": round(res["aee"], 4),
-                       "aae": round(res["aae"], 4),
-                       "val_loss": round(res["val_loss"], 4),
-                       "wall_s": round(time.time() - t0, 1)}
-                f.write(json.dumps(rec) + "\n")
-                f.flush()
-                print(rec, flush=True)
-                if res["aee"] < args.target_epe:
-                    print(f"target EPE {args.target_epe} reached at step {s}",
-                          flush=True)
-                    return
-            b = jax.device_put(ds.sample_train(batch, rng=rng),
-                               batch_sharding(mesh))
-            state, _ = step(state, b)
+        best_aee, best_step = float("inf"), 0
+        done = {"written": False}
+
+        def outcome(stopped_at: int, note: str) -> None:
+            # the artifact's terminal record, emitted by THIS tool on
+            # every exit path so the file is regenerable (ADVICE r02);
+            # best_aee is null if no finite eval ever landed (divergence)
+            done["written"] = True
+            f.write(json.dumps({
+                "kind": "outcome",
+                "best_aee": round(best_aee, 4) if np.isfinite(best_aee)
+                else None,
+                "best_step": best_step, "stopped_at_step": stopped_at,
+                "zero_flow_epe": round(zero_epe, 4), "note": note,
+                "wall_s": round(time.time() - t0, 1)}) + "\n")
+            f.flush()
+
+        s = 0
+        try:
+            for s in range(args.steps + 1):
+                if s % args.eval_every == 0:
+                    res = evaluate_aee(eval_fn, state.params, ds, cfg)
+                    rec = {"kind": "eval", "step": s,
+                           "aee": round(res["aee"], 4),
+                           "aae": round(res["aae"], 4),
+                           "val_loss": round(res["val_loss"], 4),
+                           "lr": schedule(s),
+                           "wall_s": round(time.time() - t0, 1)}
+                    if res["aee"] < best_aee:
+                        best_aee, best_step = res["aee"], s
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()
+                    print(rec, flush=True)
+                    if res["aee"] < args.target_epe:
+                        print(f"target EPE {args.target_epe} reached at "
+                              f"step {s}", flush=True)
+                        outcome(s, f"target {args.target_epe} px reached")
+                        return
+                b = jax.device_put(ds.sample_train(batch, rng=rng),
+                                   batch_sharding(mesh))
+                state, _ = step(state, b)
+        finally:
+            if not done["written"]:
+                # interrupted (Ctrl-C / error) or budget exhausted:
+                # terminate the artifact either way
+                note = ("step budget exhausted before target"
+                        if s >= args.steps else f"interrupted at step {s}")
+                outcome(s, note)
         print("step budget exhausted before target EPE", flush=True)
         raise SystemExit(1)
 
