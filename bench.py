@@ -300,6 +300,9 @@ def time_train_step(step, state, b, steps: int = 10, windows: int = 3,
     for _ in range(max(warmup, 1)):  # >=1: m must exist for the fetch
         state, m = step(state, b)
     val = jax.device_get(m[metrics_key])
+    # fail fast BEFORE spending the timing windows: a NaN step (or tunnel
+    # garbage) should cost warmup steps, not the whole accelerator window
+    assert np.isfinite(val).all(), f"non-finite {metrics_key} after warmup: {val}"
     best = float("inf")
     for _ in range(windows):
         t0 = time.perf_counter()

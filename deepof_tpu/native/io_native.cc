@@ -282,32 +282,53 @@ bool decode_image_file(const char* path, std::vector<uint8_t>* buf, int* w,
 // match the reference's cv2 pipeline (`flyingChairsLoader.py:71-79`).
 void resize_bilinear_bgr(const uint8_t* src, int sh, int sw, float* dst,
                          int dh, int dw) {
+  if (sh == dh && sw == dw) {
+    // identity: pure uint8 -> float32 + RGB->BGR swap, no interpolation
+    // (the FlyingChairs default keeps the native 384x512 resolution)
+    const size_t n = static_cast<size_t>(sh) * sw;
+    for (size_t i = 0; i < n; ++i) {
+      dst[i * 3 + 0] = src[i * 3 + 2];
+      dst[i * 3 + 1] = src[i * 3 + 1];
+      dst[i * 3 + 2] = src[i * 3 + 0];
+    }
+    return;
+  }
+  // per-x coefficients once per image, not per pixel (the float math and
+  // clamping in the inner loop cost more than the blend itself)
+  std::vector<int> x0v(dw), x1v(dw);
+  std::vector<float> wxv(dw);
   const float ys = static_cast<float>(sh) / dh;
   const float xs = static_cast<float>(sw) / dw;
-  for (int y = 0; y < dh; ++y) {
+  for (int x = 0; x < dw; ++x) {
     // cv2-style half-pixel centers
+    float fx = (x + 0.5f) * xs - 0.5f;
+    int x0 = static_cast<int>(fx > 0 ? fx : 0);
+    if (x0 > sw - 1) x0 = sw - 1;
+    x0v[x] = x0 * 3;
+    x1v[x] = (x0 + 1 < sw ? x0 + 1 : sw - 1) * 3;
+    float wx = fx - x0;
+    wxv[x] = wx < 0 ? 0 : wx;
+  }
+  for (int y = 0; y < dh; ++y) {
     float fy = (y + 0.5f) * ys - 0.5f;
     int y0 = static_cast<int>(fy > 0 ? fy : 0);
     if (y0 > sh - 1) y0 = sh - 1;
     int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
     float wy = fy - y0;
     if (wy < 0) wy = 0;
+    const uint8_t* r0 = src + static_cast<size_t>(y0) * sw * 3;
+    const uint8_t* r1 = src + static_cast<size_t>(y1) * sw * 3;
+    float* out = dst + static_cast<size_t>(y) * dw * 3;
     for (int x = 0; x < dw; ++x) {
-      float fx = (x + 0.5f) * xs - 0.5f;
-      int x0 = static_cast<int>(fx > 0 ? fx : 0);
-      if (x0 > sw - 1) x0 = sw - 1;
-      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
-      float wx = fx - x0;
-      if (wx < 0) wx = 0;
-      const uint8_t* a = src + (static_cast<size_t>(y0) * sw + x0) * 3;
-      const uint8_t* b = src + (static_cast<size_t>(y0) * sw + x1) * 3;
-      const uint8_t* c = src + (static_cast<size_t>(y1) * sw + x0) * 3;
-      const uint8_t* d = src + (static_cast<size_t>(y1) * sw + x1) * 3;
-      float* out = dst + (static_cast<size_t>(y) * dw + x) * 3;
+      const uint8_t* a = r0 + x0v[x];
+      const uint8_t* b = r0 + x1v[x];
+      const uint8_t* c = r1 + x0v[x];
+      const uint8_t* d = r1 + x1v[x];
+      const float wx = wxv[x];
       for (int ch = 0; ch < 3; ++ch) {
         float top = a[ch] + wx * (b[ch] - a[ch]);
         float bot = c[ch] + wx * (d[ch] - c[ch]);
-        out[2 - ch] = top + wy * (bot - top);  // RGB -> BGR
+        out[x * 3 + 2 - ch] = top + wy * (bot - top);  // RGB -> BGR
       }
     }
   }

@@ -141,6 +141,7 @@ def main() -> None:
             f.flush()
 
         s = 0
+        completed = False
         try:
             for s in range(args.steps + 1):
                 if s % args.eval_every == 0:
@@ -164,12 +165,13 @@ def main() -> None:
                 b = jax.device_put(ds.sample_train(batch, rng=rng),
                                    batch_sharding(mesh))
                 state, _ = step(state, b)
+            completed = True
         finally:
             if not done["written"]:
                 # interrupted (Ctrl-C / error) or budget exhausted:
-                # terminate the artifact either way
-                note = ("step budget exhausted before target"
-                        if s >= args.steps else f"interrupted at step {s}")
+                # terminate the artifact either way, labeled truthfully
+                note = ("step budget exhausted before target" if completed
+                        else f"interrupted at step {s}")
                 outcome(s, note)
         print("step budget exhausted before target EPE", flush=True)
         raise SystemExit(1)
