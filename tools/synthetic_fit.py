@@ -33,7 +33,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=4000)
     ap.add_argument("--eval-every", type=int, default=50)
-    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--devices", type=int, default=1,
+                    help="virtual CPU devices; 0 = do NOT force CPU, use "
+                         "the default backend (the real chip) — minutes "
+                         "instead of days for the <1px run")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--lr-decay-every", type=int, default=1500,
@@ -47,7 +50,8 @@ def main() -> None:
         "artifacts", "synthetic_fit.jsonl"))
     args = ap.parse_args()
 
-    force_cpu_devices(args.devices)
+    if args.devices > 0:
+        force_cpu_devices(args.devices)
     import jax
     import jax.numpy as jnp
     import numpy as np
