@@ -61,10 +61,12 @@ class LossConfig:
     # vs the raw head output (gen-1 `version1/model/warpflow.py:37,133`).
     smooth_scaled_flow: bool = True
     border_ratio: float = 0.1
-    # Warp implementation: "xla" (fused XLA gather, any level size),
-    # "pallas" (VMEM row-sweep kernel, W <= 128 only), "auto" (pallas for
-    # coarse pyramid levels, XLA for fine — see ops/pallas/warp.py).
-    warp_impl: str = "xla"
+    # Warp implementation: "xla" (one fused patch-gather, any level
+    # size), "pallas" (VMEM row-sweep kernel, W <= 128 only), "auto"
+    # (pallas wherever admissible, xla for fine levels). Default "auto":
+    # measured fastest on v5e at every admissible level shape, fwd and
+    # grad (perf_probe warp section, r03; see ops/pallas/warp.py).
+    warp_impl: str = "auto"
     # Photometric penalty: "charbonnier" = the reference's raw-RGB
     # Charbonnier (`flyingChairsWrapFlow.py:841-851`); "census" = soft
     # census-transform distance (ops/census.py) — illumination-robust,

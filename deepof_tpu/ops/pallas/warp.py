@@ -278,7 +278,8 @@ def _bwd(interpret, res, g):
     # image cotangent: XLA bilinear scatter; under jit it is dead-code-
     # eliminated when the image operand is data (the default loss). Eager
     # op-by-op grads do pay it — debug-only territory
-    gi = jax.vjp(lambda im: backward_warp(im, flow), image)[1](g32)[0]
+    gi = jax.vjp(lambda im: backward_warp(im, flow, impl="xla"),
+                 image)[1](g32)[0]
     return gi.astype(image.dtype), gf.astype(flow.dtype)
 
 

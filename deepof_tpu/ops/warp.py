@@ -22,21 +22,36 @@ single dynamic-gather, which is the right tool for fine pyramid levels
 `ops/pallas/warp.py`). For coarse levels (W <= 128) the Pallas row-sweep
 kernel computes the same warp in one VMEM pass; select it with
 `impl="pallas"` or `impl="auto"`.
+
+Gather-cost note: a TPU gather's cost scales with the index count times
+the gathered-row width, and narrow rows waste the 128-lane datapath. The
+naive formulation issues FOUR gathers of C(=3)-wide rows (one per
+bilinear neighbor, 3/128 lane utilization). The XLA path here instead
+packs the 2x2 neighborhood into channels with two edge-clamped shifts
+(patch = [img, img_x+1, img_y+1, img_xy], a (B,H,W,4C) tensor built by
+cheap rolls) and issues ONE gather of 4C-wide rows at the (y0, x0) base
+address: 4x fewer indices, 4x wider rows. Border exactness: the shifted
+channels give neighbor min(x0+1, w-1) instead of the reference's
+x1 = clip(x+fx+1), which differ only when x+fx < 0 (both collapse to
+column 0 there); zeroing the fractional weight on that saturated side
+reproduces the reference's value AND its (zero) flow gradient exactly.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-#: levels at least this small on both sides use the Pallas kernel under
-#: impl="auto" (W must fit one 128-lane register; the 2H-1 row sweep is
-#: what bounds the kernel's cost, so very tall-narrow inputs stay on XLA).
-PALLAS_AUTO_MAX_H = 64
-
-
-def _gather_hw(img_flat: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """img_flat: (B, H*W, C); idx: (B, H*W) int32 -> (B, H*W, C)."""
-    return jnp.take_along_axis(img_flat, idx[..., None], axis=1)
+#: impl="auto" routes to the Pallas kernel when W <= 128 (the kernel's
+#: hard limit: one 128-lane register) AND H <= 128. Measured on v5e
+#: (perf_probe warp section, r03): the kernel beats the XLA gather at
+#: every real pyramid level it admits (40x56 and 80x112, fwd and grad —
+#: no admissible level is taller than 80). The H cap is a safety fence,
+#: not a tuning knob: the kernel holds whole (Hp, 128) planes in VMEM
+#: and its row sweep is a serial 2H-1 loop, so a tall-narrow input
+#: (e.g. 4096x64) would compile slowly or not at all — such shapes fall
+#: back to the XLA patch-gather instead.
+PALLAS_AUTO_MAX_W = 128
+PALLAS_AUTO_MAX_H = 128
 
 
 def backward_warp(image: jnp.ndarray, flow: jnp.ndarray,
@@ -46,26 +61,26 @@ def backward_warp(image: jnp.ndarray, flow: jnp.ndarray,
     `flow` must already include any flow_scale factor (the caller applies it,
     as the reference does at `flyingChairsWrapFlow.py:785`).
 
-    impl: "xla" (fused XLA gather, any size), "pallas" (VMEM row-sweep
-    kernel, requires W <= 128), or "auto" (pallas for small levels).
+    impl: "xla" (one fused patch-gather, any size; the function default —
+    golden tests and the Pallas image-cotangent fallback reference it),
+    "pallas" (VMEM row-sweep kernel, requires W <= 128), or "auto"
+    (pallas where admissible, xla for fine levels — the measured-fastest
+    choice and the `LossConfig.warp_impl` default).
     """
     b, h, w, c = image.shape
-    if impl == "pallas" or (impl == "auto" and w <= 128
+    if impl == "pallas" or (impl == "auto" and w <= PALLAS_AUTO_MAX_W
                             and h <= PALLAS_AUTO_MAX_H):
         from .pallas.warp import backward_warp_pallas
 
         return backward_warp_pallas(image, flow)
     elif impl not in ("xla", "auto"):
         raise ValueError(f"unknown warp impl {impl!r}")
-    img_flat = image.reshape(b, h * w, c)
     flow_flat = flow.reshape(b, h * w, 2)
 
     floor_flow = jnp.floor(flow_flat)
     frac = flow_flat - floor_flow
     fx = floor_flow[..., 0].astype(jnp.int32)  # u -> x offset
     fy = floor_flow[..., 1].astype(jnp.int32)  # v -> y offset
-    wx = frac[..., 0][..., None]
-    wy = frac[..., 1][..., None]
 
     # Flat pixel grid: x = column index, y = row index.
     ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.int32),
@@ -74,14 +89,25 @@ def backward_warp(image: jnp.ndarray, flow: jnp.ndarray,
     pos_y = ys.reshape(-1)[None, :]
 
     x0 = jnp.clip(pos_x + fx, 0, w - 1)
-    x1 = jnp.clip(pos_x + fx + 1, 0, w - 1)
     y0 = jnp.clip(pos_y + fy, 0, h - 1)
-    y1 = jnp.clip(pos_y + fy + 1, 0, h - 1)
+    # Left/top saturation: the reference's independently clipped +1
+    # neighbor collapses onto x0/y0 there; the patch channels instead hold
+    # column/row 1 — zeroing the fractional weight on the saturated side
+    # restores exact value and (zero) flow-gradient. Right/bottom
+    # saturation needs nothing: min(x0+1, w-1) == clip(x+fx+1) there.
+    wx = jnp.where(pos_x + fx < 0, 0.0, frac[..., 0])[..., None]
+    wy = jnp.where(pos_y + fy < 0, 0.0, frac[..., 1])[..., None]
 
-    ia = _gather_hw(img_flat, y0 * w + x0)
-    ib = _gather_hw(img_flat, y1 * w + x0)
-    ic = _gather_hw(img_flat, y0 * w + x1)
-    id_ = _gather_hw(img_flat, y1 * w + x1)
+    # 2x2 neighborhood packed into channels by edge-clamped shifts, then
+    # ONE gather of (B, H*W) indices over 4C-wide rows (see module note).
+    img_x = jnp.concatenate([image[:, :, 1:], image[:, :, -1:]], axis=2)
+    img_y = jnp.concatenate([image[:, 1:], image[:, -1:]], axis=1)
+    img_xy = jnp.concatenate([img_x[:, 1:], img_x[:, -1:]], axis=1)
+    patch = jnp.concatenate([image, img_x, img_y, img_xy], axis=-1)
+    g = jnp.take_along_axis(patch.reshape(b, h * w, 4 * c),
+                            (y0 * w + x0)[..., None], axis=1)
+    ia, ic, ib, id_ = (g[..., :c], g[..., c:2 * c],
+                       g[..., 2 * c:3 * c], g[..., 3 * c:])
 
     out = (ia * (1 - wx) * (1 - wy) + ib * (1 - wx) * wy
            + ic * wx * (1 - wy) + id_ * wx * wy)

@@ -136,9 +136,18 @@ def sec_decomp() -> None:
         model, p, bb, ds.mean, cfg.loss, compute_dtype=jnp.bfloat16)[0])
     timeit("inception fwd+loss", fwd_loss, state.params, b, items=B)
 
-    fwd_loss_grad = jax.jit(lambda p, bb: jax.value_and_grad(
-        lambda q: model_losses(model, q, bb, ds.mean, cfg.loss,
-                               compute_dtype=jnp.bfloat16)[0])(p)[0])
+    def _fwd_loss_grad(p, bb):
+        val, grads = jax.value_and_grad(
+            lambda q: model_losses(model, q, bb, ds.mean, cfg.loss,
+                                   compute_dtype=jnp.bfloat16)[0])(p)
+        # keep every grad leaf alive: returning only `val` lets XLA DCE
+        # the entire backward (caught in r03 — this line then measured
+        # identical to fwd+loss)
+        # 1e-30 scale (not *0: XLA may fold mul-by-zero and DCE again)
+        return val + 1e-30 * sum(jnp.sum(g)
+                                 for g in jax.tree_util.tree_leaves(grads))
+
+    fwd_loss_grad = jax.jit(_fwd_loss_grad)
     timeit("inception fwd+loss+bwd", fwd_loss_grad, state.params, b, items=B)
 
     per, state = _time_full_step(step, state, b)
