@@ -197,6 +197,17 @@ def _import_compute() -> None:
         import jax.numpy as _jnp
         import numpy as _np
         jax, jnp, np = _jax, _jnp, _np
+        # Persistent compilation cache for the TPU path too (the CPU test
+        # mesh already enables it via force_cpu_devices): window-1 r03
+        # spent ~10 of 47 live-tunnel minutes recompiling the same
+        # graphs per attempt. Best-effort — harmless if the backend
+        # ignores it.
+        try:
+            from deepof_tpu.core.hostmesh import COMPILE_CACHE_DIR
+            jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:  # noqa: BLE001 - cache is an optimization only
+            pass
 
 
 def calibrate(n: int = 4096, reps: int = 10) -> dict:

@@ -11,10 +11,14 @@ Sections (in the order a short tunnel window should spend them):
   calib    raw matmul TFLOP/s + RTT (tunnel-condition context)
   decomp   Inception-v3 train-step decomposition (fwd / fwd+loss /
            +bwd / full step, and the pyramid-loss/warp share)
-  warp     XLA vs Pallas warp at coarse/mid levels, fwd and grad
-  batch    batch-size throughput curve (16/32/64/96)
+  warpscan device-honest warp timing: 20 warps chained inside one jit
+           (per-call dispatch floor amortized away), incl. the finest
+           160x224 level — supersedes `warp` for decisions
   spc      steps_per_call sweep (1/2/4/8): dispatch+RTT amortization
+  batch    batch-size throughput curve (16/32/64/96)
   headline bench.py headline (value + MFU fields)
+  warp     per-call XLA vs Pallas warp table (dispatch-contaminated on
+           a high-RTT tunnel; kept for cross-window comparability)
 """
 
 from __future__ import annotations
@@ -114,6 +118,53 @@ def sec_warp() -> None:
             timeit(f"warp grad {impl} {h}x{w}", g, img, flow)
 
 
+def sec_warp_scan() -> None:
+    """Device-honest warp timing: 20 warps chained inside ONE jit via
+    lax.scan, so the per-call dispatch floor (~10 ms on a 67 ms-RTT
+    tunnel, which contaminated the per-call warp table in window 1)
+    amortizes to noise. Includes the finest pyramid level (160x224,
+    XLA-only: W > 128) to decide whether a two-lane-tile W<=256 Pallas
+    variant is worth building."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from deepof_tpu.ops.warp import backward_warp
+
+    key = jax.random.PRNGKey(0)
+    n_inner = 20
+    for (h, w) in [(40, 56), (80, 112), (160, 224)]:
+        img = jax.random.uniform(key, (16, h, w, 3))
+        flow = jax.random.uniform(key, (16, h, w, 2)) * 8 - 4
+        impls = ("xla",) if w > 128 else ("xla", "pallas")
+        for impl in impls:
+            def scan_fwd(i, fl, impl=impl):
+                def body(f, _):
+                    out = backward_warp(i, f, impl=impl)
+                    # chain: next flow depends on this warp's output
+                    # (1e-30 scale, not *0: XLA may fold mul-by-zero
+                    # and DCE the warp — the sec_decomp lesson)
+                    return f + 1e-30 * out.mean(), None
+                return lax.scan(body, fl, None, length=n_inner)[0].sum()
+
+            f = jax.jit(scan_fwd)
+            per = timeit(f"warp scan fwd {impl} {h}x{w}", f, img, flow)
+            print(f"{'  -> per-warp':44s} {per/n_inner*1e3:8.3f} ms",
+                  flush=True)
+
+            def scan_grad(i, fl, impl=impl):
+                def body(f, _):
+                    g = jax.grad(lambda q: backward_warp(
+                        i, q, impl=impl).sum())(f)
+                    return f + 1e-30 * g, None
+                return lax.scan(body, fl, None, length=n_inner)[0].sum()
+
+            g = jax.jit(scan_grad)
+            per = timeit(f"warp scan grad {impl} {h}x{w}", g, img, flow)
+            print(f"{'  -> per-grad':44s} {per/n_inner*1e3:8.3f} ms",
+                  flush=True)
+
+
 def sec_decomp() -> None:
     import jax
     import jax.numpy as jnp
@@ -198,13 +249,18 @@ def sec_headline() -> None:
                      for k, v in res.items()}, flush=True)
 
 
+# Execution order = priority order for a short tunnel window: the
+# decomposition (before/after for each landed optimization) first, then
+# the device-honest warp scan, then the dispatch-amortization sweeps;
+# the per-call warp table is superseded by warpscan and runs last.
 SECTIONS = {
     "calib": sec_calib,
     "decomp": sec_decomp,
-    "warp": sec_warp,
-    "batch": sec_batch,
+    "warpscan": sec_warp_scan,
     "spc": sec_spc,
+    "batch": sec_batch,
     "headline": sec_headline,
+    "warp": sec_warp,
 }
 
 

@@ -19,11 +19,13 @@ i=0
 while [ $i -lt 60 ]; do
     i=$((i + 1))
     echo "$(stamp) perf_probe attempt $i" >> "$PLOG"
-    if timeout 3600 python tools/perf_probe.py --wait-s 600 >> "$PLOG" 2>&1; then
+    timeout 3600 python tools/perf_probe.py --wait-s 600 >> "$PLOG" 2>&1
+    rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
+    if [ "$rc" -eq 0 ]; then
         echo "$(stamp) perf_probe SUCCESS" >> "$PLOG"
         break
     fi
-    echo "$(stamp) perf_probe attempt $i failed (rc=$?)" >> "$PLOG"
+    echo "$(stamp) perf_probe attempt $i failed (rc=$rc)" >> "$PLOG"
     sleep 120
 done
 
@@ -37,13 +39,15 @@ while [ $i -lt 20 ]; do
         sleep 300
         continue
     fi
-    if timeout 3600 python tools/synthetic_fit.py --devices 0 \
+    timeout 3600 python tools/synthetic_fit.py --devices 0 \
         --steps 30000 --eval-every 250 --lr-decay-every 4000 \
-        --out artifacts/synthetic_fit_tpu.jsonl >> "$FLOG" 2>&1; then
+        --out artifacts/synthetic_fit_tpu.jsonl >> "$FLOG" 2>&1
+    rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
+    if [ "$rc" -eq 0 ]; then
         echo "$(stamp) synthetic_fit TPU SUCCESS" >> "$FLOG"
         break
     fi
-    echo "$(stamp) synthetic_fit attempt $i failed (rc=$?)" >> "$FLOG"
+    echo "$(stamp) synthetic_fit attempt $i failed (rc=$rc)" >> "$FLOG"
     sleep 120
 done
 echo "$(stamp) chain done" >> "$PLOG"
