@@ -451,7 +451,13 @@ class SyntheticData:
 
     Each sample: a smooth random image; the target is the source translated
     by a per-sample constant (u, v) — so GT flow is uniform and the
-    unsupervised loss is minimized by the true flow.
+    unsupervised loss is minimized by the true flow. style="affine"
+    generalizes to a spatially VARYING exact-GT field (rotation/scale/shear
+    about a random center, magnitude bounded by max_shift): the source is
+    constructed as the bilinear backward warp of the target canvas by the
+    GT field, so the unsupervised objective's minimizer is still exactly
+    the GT flow, but a network can no longer satisfy it with a single
+    global translation — it must discriminate spatially.
     """
 
     mean = (0.0, 0.0, 0.0)
@@ -479,6 +485,8 @@ class SyntheticData:
     def _sample(self, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         rng = np.random.RandomState(seed)
         h, w = self.cfg.image_size
+        if self._style == "affine":
+            return self._sample_affine(rng, h, w)
         if self._style == "blobs":
             img = self._blob_canvas(rng, h + 16, w + 16)
         else:
@@ -496,6 +504,40 @@ class SyntheticData:
             np.asarray([-u, -v], np.float32), (h, w, 2)
         ).copy()
         return src, tgt, flow
+
+    def _sample_affine(self, rng, h: int, w: int):
+        """Spatially varying exact-GT pair. GT field g = affine(p - c) + t,
+        rescaled so max |g| <= max_shift. Construction: the TARGET is the
+        blob canvas; the SOURCE is the exact bilinear backward warp of the
+        target by g (cv2.remap) — i.e. src[p] = tgt[p + g(p)] by
+        construction, which is precisely what the photometric loss's
+        reconstruction computes, so its minimizer is g and AEE-vs-g is an
+        exact learning metric (same convention as the shift styles:
+        tgt[p + flow] == src[p])."""
+        tgt = self._blob_canvas(rng, h, w)
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        cy, cx = rng.rand(2) * [h - 1, w - 1]
+        # rotation + log-scale + shear, each small; plus translation
+        ang = (rng.rand() - 0.5) * 0.2
+        scale = 1.0 + (rng.rand() - 0.5) * 0.1
+        shear = (rng.rand() - 0.5) * 0.1
+        a = np.asarray([[np.cos(ang), -np.sin(ang)],
+                        [np.sin(ang), np.cos(ang)]], np.float32)
+        a = a @ np.asarray([[scale, shear], [0.0, 1.0 / scale]], np.float32)
+        a -= np.eye(2, dtype=np.float32)
+        tu, tv = (rng.rand(2) * 2 - 1) * self._max_shift * 0.5
+        gu = a[0, 0] * (xx - cx) + a[0, 1] * (yy - cy) + tu
+        gv = a[1, 0] * (xx - cx) + a[1, 1] * (yy - cy) + tv
+        mag = float(np.sqrt(gu**2 + gv**2).max())
+        if mag > self._max_shift:
+            gu *= self._max_shift / mag
+            gv *= self._max_shift / mag
+        gu = gu.astype(np.float32)  # tu/tv are python floats -> f64 maps
+        gv = gv.astype(np.float32)
+        src = cv2.remap(tgt, xx + gu, yy + gv, cv2.INTER_LINEAR,
+                        borderMode=cv2.BORDER_REPLICATE)
+        flow = np.stack([gu, gv], axis=-1)
+        return src.astype(np.float32), tgt, flow
 
     def _blob_canvas(self, rng, ch: int, cw: int) -> np.ndarray:
         """Smooth linear-gradient background + sparse Gaussian blobs

@@ -226,6 +226,29 @@ def test_synthetic_blobs_style_consistency():
     np.testing.assert_array_equal(b["source"], b2["source"])
 
 
+def test_synthetic_affine_style_consistency():
+    """The affine style's spatially varying GT field keeps the loss
+    contract: backward_warp(target, flow) reconstructs the source up to
+    cv2.remap's fixed-point bilinear quantization (INTER_LINEAR uses
+    5-bit fractional weights, so ~1/32 of the local dynamic range —
+    values are 0..255, hence the ~2-gray-level tolerance)."""
+    from deepof_tpu.ops.warp import backward_warp
+
+    cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=2)
+    ds = SyntheticData(cfg, max_shift=3, style="affine")
+    b = ds.sample_train(2, iteration=0)
+    flow = b["flow"]
+    assert float(np.abs(flow).max()) <= 3.0 + 1e-5
+    # the field must actually vary spatially (the style's whole point)
+    assert float(np.std(flow[0, ..., 0])) > 1e-2
+    recon = np.asarray(backward_warp(b["target"], b["flow"]))
+    m = 4
+    np.testing.assert_allclose(recon[:, m:-m, m:-m],
+                               b["source"][:, m:-m, m:-m], atol=2.0)
+    b2 = ds.sample_train(2, iteration=0)
+    np.testing.assert_array_equal(b["source"], b2["source"])
+
+
 def test_build_dataset_dispatch():
     cfg = DataConfig(dataset="synthetic", image_size=(16, 16))
     assert isinstance(build_dataset(cfg), SyntheticData)

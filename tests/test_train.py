@@ -182,10 +182,13 @@ def test_steps_per_call_matches_single(tmp_path):
     assert int(state2.step) == 2
     np.testing.assert_allclose(float(m2["total"][-1]), single_total, rtol=1e-5)
     # scanned vs unrolled compiles reassociate float math; params agree to
-    # ~1e-4 relative after two Adam steps
+    # ~1e-4 relative after two Adam steps. atol covers near-zero-gradient
+    # elements where Adam's 1/(sqrt(v)+eps) amplifies reassociation noise
+    # (seen: 1 of 1.18M elements at |diff| 2.8e-5 once warp_impl=auto made
+    # the scanned/unrolled pair reassociate through the Pallas kernel).
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, jax.device_get(b),
-                                                rtol=1e-3, atol=1e-5),
+                                                rtol=1e-3, atol=5e-5),
         single_params, state2.params)
 
 

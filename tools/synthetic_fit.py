@@ -43,7 +43,8 @@ def main() -> None:
                     help="halve lr every N steps (0 = constant)")
     ap.add_argument("--feature-scale", type=int, default=16)
     ap.add_argument("--max-shift", type=float, default=4.0)
-    ap.add_argument("--style", default="blobs", choices=("noise", "blobs"))
+    ap.add_argument("--style", default="blobs",
+                    choices=("noise", "blobs", "affine"))
     ap.add_argument("--target-epe", type=float, default=1.0)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

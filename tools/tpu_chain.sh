@@ -45,9 +45,28 @@ while [ $i -lt 20 ]; do
     rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
     if [ "$rc" -eq 0 ]; then
         echo "$(stamp) synthetic_fit TPU SUCCESS" >> "$FLOG"
+        fit_ok=1
         break
     fi
     echo "$(stamp) synthetic_fit attempt $i failed (rc=$rc)" >> "$FLOG"
     sleep 120
 done
+
+# Stretch goal once the blobs fit SUCCEEDED (fit_ok set only on rc=0;
+# the jsonl alone is no proxy — synthetic_fit writes its meta record
+# before training starts): the affine style's spatially varying GT
+# field (datasets.py SyntheticData style="affine") — stronger learning
+# evidence than a global shift. One attempt per window pass.
+if [ "${fit_ok:-0}" -eq 1 ]; then
+    echo "$(stamp) affine fit attempt" >> "$FLOG"
+    if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        timeout 3600 python tools/synthetic_fit.py --devices 0 --style affine \
+            --steps 30000 --eval-every 250 --lr-decay-every 4000 \
+            --out artifacts/synthetic_fit_tpu_affine.jsonl >> "$FLOG" 2>&1
+        rc=$?
+        echo "$(stamp) affine fit rc=$rc" >> "$FLOG"
+    else
+        echo "$(stamp) affine fit skipped: tunnel down" >> "$FLOG"
+    fi
+fi
 echo "$(stamp) chain done" >> "$PLOG"
