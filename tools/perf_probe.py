@@ -222,7 +222,10 @@ def sec_decomp() -> None:
 def sec_batch() -> None:
     # throughput curve: same model, growing batch; is the chip compute-
     # bound (flat items/s => yes) or dispatch/HBM-bound (rising)?
-    for batch in (16, 32, 64, 96):
+    # Two points only: each batch size is a distinct ~5-min remote
+    # compile, and the decision (does 96 beat 16?) needs just the ends;
+    # window 1 died mid-sweep paying for the interior points.
+    for batch in (16, 96):
         cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup(
             batch=batch)
         per, _ = _time_full_step(step, state, b, windows=2)
@@ -233,8 +236,10 @@ def sec_batch() -> None:
 def sec_spc() -> None:
     # steps_per_call sweep: K optimizer steps per dispatch; the gap
     # between K=1 and K->8 per-step times IS the per-dispatch host/
-    # transport overhead (DESIGN.md "Benchmark honesty").
-    for k in (1, 2, 4, 8):
+    # transport overhead (DESIGN.md "Benchmark honesty"). K=2 dropped:
+    # each K is a distinct large remote compile; 1/4/8 brackets the
+    # amortization curve.
+    for k in (1, 4, 8):
         cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup(
             steps_per_call=k)
         per_call, _ = _time_full_step(step, state, b, steps=6, windows=2)
