@@ -67,6 +67,12 @@ class LossConfig:
     # measured fastest on v5e at every admissible level shape, fwd and
     # grad (perf_probe warp section, r03; see ops/pallas/warp.py).
     warp_impl: str = "auto"
+    # Warp OPERAND dtype for the photometric reconstruction gather:
+    # "float32" (exact reference numerics, default) or "bfloat16" (half
+    # the gathered bytes on the fine-level XLA path; ~0.4% relative
+    # quantization of the warped image and its flow-gradient factors —
+    # an opt-in throughput lever, see DESIGN.md).
+    gather_dtype: str = "float32"
     # Photometric penalty: "charbonnier" = the reference's raw-RGB
     # Charbonnier (`flyingChairsWrapFlow.py:841-851`); "census" = soft
     # census-transform distance (ops/census.py) — illumination-robust,

@@ -137,6 +137,20 @@ def occlusion_mask(flow_fw: jnp.ndarray, flow_bw: jnp.ndarray,
     return (sq < bound).astype(flow_fw.dtype)
 
 
+def _warp_operand(x: jnp.ndarray, cfg: LossConfig) -> jnp.ndarray:
+    """Warp-operand dtype policy (loss.gather_dtype): bf16 halves the
+    gathered bytes on the fine-level XLA path (an opt-in throughput
+    lever); default f32 preserves exact reference numerics. Validated
+    here like the module's other enum fields."""
+    if cfg.gather_dtype == "bfloat16":
+        return x.astype(jnp.bfloat16)
+    if cfg.gather_dtype != "float32":
+        raise ValueError(
+            f"unknown loss.gather_dtype {cfg.gather_dtype!r}; "
+            "use 'float32' or 'bfloat16'")
+    return x
+
+
 def _smoothness_diffs(cfg: LossConfig, h: int, w: int):
     """(diff_x, diff_y, mask_x, mask_y) for the configured prior order.
 
@@ -173,7 +187,11 @@ def loss_interp(
     """
     b, h, w, c = inputs.shape
     scaled = flow * flow_scale
-    recon = backward_warp(outputs, scaled, impl=cfg.warp_impl)
+    # Byte-halving bf16 warp operand iff the gather is byte-bound —
+    # perf_probe warpscan answers which; the Pallas path upcasts
+    # internally either way (see _warp_operand).
+    recon = backward_warp(_warp_operand(outputs, cfg), scaled,
+                          impl=cfg.warp_impl).astype(inputs.dtype)
     # needImageGradients (`flyingChairsWrapFlow_vgg.py:226-301`): the same
     # per-sample gradient-magnitude mask weights the photometric term by
     # |grad| and BOTH smoothness terms by 1-|grad| (edges may move freely).
@@ -312,7 +330,8 @@ def loss_interp_multi(
     b, h, w, c3t = volume.shape
     t = c3t // 3
     scaled = flows * flow_scale
-    recon = backward_warp_volume(volume, scaled, impl=cfg.warp_impl)
+    recon = backward_warp_volume(_warp_operand(volume, cfg), scaled,
+                                 impl=cfg.warp_impl).astype(volume.dtype)
 
     bmask = border_mask(h, w, cfg.border_ratio)
     diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])

@@ -407,3 +407,23 @@ def test_tiny_level_no_nan(rng):
     flows = jnp.asarray(rng.randn(1, 1, 2, 4).astype(np.float32))
     ld2, _ = loss_interp_multi(flows, vol, 1.0, _loss_cfg())
     assert np.isfinite(float(ld2["total"]))
+
+
+def test_gather_dtype_bf16_close_to_f32():
+    """loss.gather_dtype='bfloat16' (opt-in throughput lever) quantizes
+    only the warped operand: the loss must stay within bf16's ~0.4%
+    relative error of the exact f32 path, and the default must remain
+    bit-identical f32."""
+    rng = np.random.RandomState(0)
+    flow = jnp.asarray(rng.randn(2, 16, 24, 2).astype(np.float32))
+    li = jnp.asarray(rng.rand(2, 16, 24, 3).astype(np.float32))
+    lo = jnp.asarray(rng.rand(2, 16, 24, 3).astype(np.float32))
+    ld32, _ = loss_interp(flow, li, lo, 2.0, _loss_cfg())
+    ld32b, _ = loss_interp(flow, li, lo, 2.0,
+                           _loss_cfg(gather_dtype="float32"))
+    assert float(ld32["total"]) == float(ld32b["total"])
+    ld16, _ = loss_interp(flow, li, lo, 2.0,
+                          _loss_cfg(gather_dtype="bfloat16"))
+    f32, f16 = float(ld32["total"]), float(ld16["total"])
+    assert f32 != 0.0
+    assert abs(f16 - f32) / abs(f32) < 0.02

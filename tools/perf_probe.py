@@ -137,6 +137,19 @@ def sec_warp_scan() -> None:
         img = jax.random.uniform(key, (16, h, w, 3))
         flow = jax.random.uniform(key, (16, h, w, 2)) * 8 - 4
         impls = ("xla",) if w > 128 else ("xla", "pallas")
+        if w > 128:
+            # byte-bound or index-bound? the loss.gather_dtype decision
+            def fwd16(i, fl):
+                def body(f, _):
+                    out = backward_warp(i.astype(jnp.bfloat16), f,
+                                        impl="xla")
+                    return f + 1e-30 * out.astype(jnp.float32).mean(), None
+                return lax.scan(body, fl, None, length=n_inner)[0].sum()
+
+            per = timeit(f"warp scan fwd xla-bf16 {h}x{w}", jax.jit(fwd16),
+                         img, flow)
+            print(f"{'  -> per-warp':44s} {per/n_inner*1e3:8.3f} ms",
+                  flush=True)
         for impl in impls:
             def scan_fwd(i, fl, impl=impl):
                 def body(f, _):
