@@ -128,3 +128,28 @@ def test_child_error_line_is_not_relayed_as_success(monkeypatch, capsys,
     assert len(lines) == 1
     assert lines[0]["value"] == 0.0
     assert "backend init exceeded" in lines[0]["error"]
+
+
+def test_warp_impl_deriisk_ladder_env(monkeypatch, capsys, tmp_path):
+    """Attempts 1-2 run the default (BENCH_WARP_IMPL=''), attempts 3+
+    force 'xla'; an operator-exported value pins every attempt."""
+    seen = []
+
+    def run(cmd, timeout, capture_output, text, env):
+        seen.append(env.get("BENCH_WARP_IMPL"))
+        monkeypatch.setattr(bench.time, "t", bench.time.t + 250)
+        return types.SimpleNamespace(returncode=1, stdout="", stderr="x")
+
+    _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit):
+        bench.orchestrate(deadline_s=1600)
+    assert len(seen) >= 3
+    assert seen[0] == "" and seen[1] == "" and set(seen[2:]) == {"xla"}
+
+    seen.clear()
+    monkeypatch.setenv("BENCH_WARP_IMPL", "xla")
+    _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit):
+        bench.orchestrate(deadline_s=1600)
+    capsys.readouterr()
+    assert seen and set(seen) == {"xla"}
