@@ -22,6 +22,7 @@ from deepof_tpu.ops.pallas.warp import backward_warp_pallas
     [((2, 5, 7, 3), 3.0),      # level-6 size: flow >> image size (all clip)
      ((2, 10, 14, 3), 30.0),   # level-5
      ((1, 40, 56, 3), 80.0),   # level-3
+     ((1, 80, 112, 3), 20.0),  # level-2: the widest auto-admitted level
      ((2, 16, 128, 2), 200.0)],  # full-lane width, huge flow
 )
 def test_pallas_warp_matches_xla(rng, shape, mag):
