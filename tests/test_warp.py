@@ -83,3 +83,18 @@ def test_volume_warp_matches_pairwise(rng):
         fl = flows[..., 2 * p : 2 * p + 2]
         want = warp_oracle(nxt, fl)
         np.testing.assert_allclose(got[..., 3 * p : 3 * p + 3], want, rtol=1e-5, atol=1e-6)
+
+
+def test_xla_warp_lowers_to_single_gather():
+    """Regression guard for the patch-gather optimization (DESIGN.md
+    'Measured step decomposition'): the XLA warp path must lower to
+    exactly ONE gather op — the 2x2 neighborhood rides as channels. A
+    second gather reappearing means the 4x index-count regression is
+    back."""
+    import jax
+
+    img = jnp.zeros((2, 20, 150, 3))
+    flow = jnp.zeros((2, 20, 150, 2))
+    txt = jax.jit(
+        lambda i, f: backward_warp(i, f, impl="xla")).lower(img, flow).as_text()
+    assert txt.count('"stablehlo.gather"(') == 1, txt.count('"stablehlo.gather"(')
