@@ -12,6 +12,9 @@ NaN handling upgrades the reference's crash-on-NaN assert
 
 from __future__ import annotations
 
+import os
+import signal
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -237,6 +240,36 @@ class Trainer:
         prefetch = Prefetcher(produce, depth=cfg.data.prefetch, sharding=sharding)
         timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
         last_eval: dict[str, float] = {}
+        # Preemption-graceful stop (SURVEY.md §5.3): TPU pods get SIGTERM
+        # before eviction; the reference dies losing everything since its
+        # last Saver call. Here the FIRST signal just ends the step loop,
+        # so the normal end-of-fit path runs: NaN-guard-checked final
+        # checkpoint + async-save commit — auto-resume then continues the
+        # schedule exactly. A SECOND signal escalates to the default
+        # action (a run hung in prefetch.get()/compile must stay killable
+        # by SIGTERM, not force an operator SIGKILL that would skip
+        # finalize()). Registered only in the main thread (signal.signal
+        # raises ValueError elsewhere — e.g. a trainer driven from a
+        # worker thread — where the host runtime owns signal handling).
+        stop_sig: dict[str, int | None] = {"sig": None}
+
+        def _on_sigterm(signum, frame):
+            if stop_sig["sig"] is not None:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            stop_sig["sig"] = signum
+
+        # explicit installed flag: signal.signal() returns None for a
+        # previous NON-Python (C-level) handler, so None cannot double as
+        # the "not installed" sentinel
+        handler_installed = False
+        prev_handler = None
+        try:
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            handler_installed = True
+        except ValueError:
+            pass
         try:
             start_step = int(self.state.step)
             total_steps = (num_epochs or cfg.train.num_epochs) * self.steps_per_epoch
@@ -260,7 +293,7 @@ class Trainer:
             gstep = start_step
             consecutive_nans = 0
             metrics = None
-            while gstep < total_steps:
+            while gstep < total_steps and stop_sig["sig"] is None:
                 batch = prefetch.get()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
                     import time as _time
@@ -335,6 +368,12 @@ class Trainer:
                     ckpt_mark = timer.mark()
                     timer.pause()
             self.profiler.maybe_stop()
+            if stop_sig["sig"] is not None:
+                self.logger.log(
+                    "warn", gstep,
+                    message=f"signal {stop_sig['sig']} received; stopping "
+                            "after a clean final checkpoint (auto-resume "
+                            "continues from here)")
             # The final state may include up to log_every-1 steps that no
             # host-visible NaN check has seen; saving it unchecked would
             # make a diverged state the newest checkpoint and defeat both
@@ -359,6 +398,15 @@ class Trainer:
         finally:
             prefetch.close()
             self.ckpt.finalize()  # commit any in-flight async save
+            # restore only AFTER finalize(): the final async-save commit
+            # must stay protected by the graceful handler. A C-level
+            # previous handler cannot be re-installed from Python
+            # (signal.signal returned None for it) — fall back to SIG_DFL
+            # so the process at least stays killable.
+            if handler_installed:
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
         rates = timer.rates()
         return {**last_eval, **rates}
 
