@@ -31,6 +31,21 @@ from .state import create_train_state, make_optimizer
 from .step import make_eval_fn, make_train_step
 
 
+def data_stream_rng(mesh, seed: int, start_step: int) -> np.random.RandomState:
+    """Host data-sampling stream for a fit() beginning at start_step.
+
+    Seeded by (process_seed, start_step): process_seed decorrelates data
+    shards while keeping replica peers identical (parallel/mesh.py);
+    start_step gives each RESUME a fresh stream — a fixed seed would
+    replay the draws already trained on, since the numpy data rng is not
+    part of the checkpoint. Array seeding is exact and order-sensitive.
+    """
+    from ..parallel.mesh import process_seed
+
+    return np.random.RandomState(np.array(
+        [process_seed(mesh, seed), start_step], dtype=np.uint32))
+
+
 def _example_input(cfg: ExperimentConfig) -> jnp.ndarray:
     h, w = cfg.data.crop_size or cfg.data.image_size
     t = cfg.data.time_step
@@ -200,12 +215,7 @@ class Trainer:
             max_steps: int | None = None) -> dict[str, float]:
         cfg = self.cfg
         self.enable_augmentation()
-        # decorrelate host sampling across data shards; processes that are
-        # replicas of one data coord get IDENTICAL streams (jax's
-        # make_array replica contract — parallel/mesh.py process_seed)
-        from ..parallel.mesh import process_seed
-
-        rng = np.random.RandomState(process_seed(self.mesh, cfg.train.seed))
+        rng = data_stream_rng(self.mesh, cfg.train.seed, int(self.state.step))
         k = max(cfg.train.steps_per_call, 1)
         if k == 1:
             sharding = batch_sharding(self.mesh)

@@ -473,3 +473,20 @@ def test_sigterm_graceful_checkpoint(tmp_path):
     # a checkpoint was committed and the run is resumable
     from deepof_tpu.train.checkpoint import CheckpointManager as _CM
     assert _CM(str(logdir / "ckpt")).latest_step() is not None
+
+
+def test_data_stream_rng_resume_no_replay():
+    """Resume must NOT replay the data stream from the beginning (the
+    numpy data rng is not checkpointed): distinct start steps give
+    distinct streams; equal inputs are deterministic; the replica
+    contract (same mesh/seed/step => identical stream) holds."""
+    from deepof_tpu.train.loop import data_stream_rng
+
+    mesh = build_mesh(MeshConfig())
+    a = data_stream_rng(mesh, 7, 0).randint(0, 2**31, 8)
+    a2 = data_stream_rng(mesh, 7, 0).randint(0, 2**31, 8)
+    b = data_stream_rng(mesh, 7, 1000).randint(0, 2**31, 8)
+    c = data_stream_rng(mesh, 8, 0).randint(0, 2**31, 8)
+    np.testing.assert_array_equal(a, a2)
+    assert not np.array_equal(a, b)
+    assert not np.array_equal(a, c)
