@@ -215,7 +215,8 @@ class Trainer:
             max_steps: int | None = None) -> dict[str, float]:
         cfg = self.cfg
         self.enable_augmentation()
-        rng = data_stream_rng(self.mesh, cfg.train.seed, int(self.state.step))
+        start_step = int(self.state.step)
+        rng = data_stream_rng(self.mesh, cfg.train.seed, start_step)
         k = max(cfg.train.steps_per_call, 1)
         if k == 1:
             sharding = batch_sharding(self.mesh)
@@ -281,7 +282,6 @@ class Trainer:
         except ValueError:
             pass
         try:
-            start_step = int(self.state.step)
             total_steps = (num_epochs or cfg.train.num_epochs) * self.steps_per_epoch
             if max_steps is not None:
                 total_steps = min(total_steps, start_step + max_steps)

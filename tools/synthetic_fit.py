@@ -45,6 +45,10 @@ def main() -> None:
     ap.add_argument("--max-shift", type=float, default=4.0)
     ap.add_argument("--style", default="blobs",
                     choices=("noise", "blobs", "affine"))
+    ap.add_argument("--blobs", type=int, default=8,
+                    help="blob count for the blobs/affine canvases; denser "
+                         "= photometric signal on more pixels (the sparse "
+                         "default leaves most pixels aperture-ambiguous)")
     ap.add_argument("--target-epe", type=float, default=1.0)
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -93,7 +97,8 @@ def main() -> None:
     )
     mesh = build_mesh(cfg.mesh)
     ds = SyntheticData(cfg.data, feature_scale=args.feature_scale,
-                       max_shift=args.max_shift, style=args.style)
+                       max_shift=args.max_shift, style=args.style,
+                       n_blobs=args.blobs)
     model = build_model("flownet_s")
 
     def schedule(s):
@@ -122,6 +127,7 @@ def main() -> None:
             "feature_scale": args.feature_scale,
             "max_shift": args.max_shift,
             "style": args.style,
+            "blobs": args.blobs,
             "zero_flow_epe": round(zero_epe, 4),
             "loss": "default flyingchairs (charbonnier, canonical, "
                     "lambda=1, weights 16/8/4/2/1/1)",
