@@ -39,8 +39,13 @@ while [ $i -lt 20 ]; do
         sleep 300
         continue
     fi
+    # dense canvas + bigger batch: the sparse default provably stalls in
+    # an aperture basin at ~3.9 px regardless of steps or LR (12k-step
+    # CPU run, artifacts/synthetic_fit_long.jsonl); the 40-blob probe
+    # shows the better trajectory (synthetic_fit_dense_probe.jsonl)
     timeout 3600 python tools/synthetic_fit.py --devices 0 \
         --steps 30000 --eval-every 250 --lr-decay-every 4000 \
+        --batch 16 --blobs 40 \
         --out artifacts/synthetic_fit_tpu.jsonl >> "$FLOG" 2>&1
     rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
     if [ "$rc" -eq 0 ]; then
@@ -62,6 +67,7 @@ if [ "${fit_ok:-0}" -eq 1 ]; then
     if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         timeout 3600 python tools/synthetic_fit.py --devices 0 --style affine \
             --steps 30000 --eval-every 250 --lr-decay-every 4000 \
+            --batch 16 --blobs 40 \
             --out artifacts/synthetic_fit_tpu_affine.jsonl >> "$FLOG" 2>&1
         rc=$?
         echo "$(stamp) affine fit rc=$rc" >> "$FLOG"
