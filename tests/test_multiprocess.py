@@ -56,7 +56,8 @@ def _single_process_reference():
     return totals, float(jax.device_get(out["total"])), eval_init
 
 
-def test_two_process_dcn_path(tmp_path):
+def _run_two_process(tmp_path):
+    """One 2-process run; returns (returncodes, outputs)."""
     port = _free_port()
     addr = f"127.0.0.1:{port}"
     env = dict(os.environ)
@@ -84,8 +85,20 @@ def test_two_process_dcn_path(tmp_path):
     finally:
         for p in procs:
             p.kill()
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    return [p.returncode for p in procs], outs
+
+
+def test_two_process_dcn_path(tmp_path):
+    rcs, outs = _run_two_process(tmp_path)
+    if any(rcs) and any("Gloo context initialization failed" in o
+                        or "DEADLINE_EXCEEDED" in o for o in outs):
+        # gloo's rendezvous has a hard 30s deadline; on this single-core
+        # host a contended scheduler (full suite + background jobs) can
+        # blow it transiently. Retry once — a deterministic failure fails
+        # both attempts.
+        rcs, outs = _run_two_process(tmp_path)
+    for rc, out in zip(rcs, outs):
+        assert rc == 0, f"worker failed:\n{out[-3000:]}"
 
     res = []
     for pid in range(2):
