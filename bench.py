@@ -82,6 +82,12 @@ def _init_devices(timeout_s: float = 240.0):
 
 PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "artifacts", "bench_probes.log")
+# Freshest successful measurement (written by bench() on every success,
+# including runs driven by tools/perf_probe.py's headline section). The
+# orchestrator's exhaustion path reports it — with its timestamp and
+# calibration context — instead of a blind 0.0 (VERDICT r03 item 1c).
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "artifacts", "last_good_bench.json")
 
 # os._exit indirection so tests can observe orchestrate()'s terminal
 # paths without killing the pytest process.
@@ -149,9 +155,12 @@ def orchestrate(deadline_s: float | None = None) -> None:
         # default (warp_impl=auto incl. Pallas kernels); from the third on,
         # force the pure-XLA warp in case the failure is a kernel-in-step
         # compile problem rather than the tunnel. An operator-exported
-        # BENCH_WARP_IMPL pins every attempt instead.
-        warp = os.environ.get("BENCH_WARP_IMPL") or (
-            "" if attempts <= 2 else "xla")
+        # BENCH_WARP_IMPL pins every attempt instead — including
+        # BENCH_WARP_IMPL="" (present-but-empty pins the config default
+        # for all attempts; only truly-unset engages the ladder).
+        warp = (os.environ["BENCH_WARP_IMPL"]
+                if "BENCH_WARP_IMPL" in os.environ
+                else ("" if attempts <= 2 else "xla"))
         _plog(f"child attempt={attempts} budget={child_budget:.0f}s"
               + (f" warp_impl={warp}" if warp else ""))
         env = dict(os.environ, BENCH_DEADLINE_S=str(child_budget - 20.0),
@@ -186,10 +195,59 @@ def orchestrate(deadline_s: float | None = None) -> None:
         time.sleep(min(20.0, max(0.0, deadline_s - (time.time() - t_start)
                                  - min_child_budget)))
     _plog(f"orchestrate exhausted attempts={attempts} last={last_err}")
-    emit(0.0, 0.0, error=f"{last_err} (after {attempts} measurement "
-         f"attempts in {deadline_s:.0f}s; probe log: artifacts/"
-         "bench_probes.log)")
+    err = (f"{last_err} (after {attempts} measurement attempts in "
+           f"{deadline_s:.0f}s; probe log: artifacts/bench_probes.log)")
+    lg = _load_last_good()
+    if lg is not None:
+        # Honest-but-not-blind fallback: the freshest chain-captured
+        # headline, clearly marked stale with its own timestamp and
+        # calibration context. value=0.0 is reserved for "no measurement
+        # exists at all".
+        _plog(f"orchestrate fallback last_good value="
+              f"{lg['res'].get('pairs_per_sec_per_chip')} "
+              f"measured_at={lg.get('measured_at')}")
+        emit(lg["res"]["pairs_per_sec_per_chip"], _vs_baseline(lg["res"]),
+             stale=True, measured_at=lg.get("measured_at"),
+             **{k: lg["res"][k] for k in _EXTRA_KEYS if k in lg["res"]},
+             error=err)
+        _exit(0)
+    emit(0.0, 0.0, error=err)
     _exit(1)
+
+
+_EXTRA_KEYS = ("matmul_tflops", "rtt_ms", "batch", "warp_impl",
+               "model_tflops", "mfu_nominal", "mfu_vs_matmul")
+
+
+def _save_last_good(res: dict) -> None:
+    try:  # best-effort: a read-only tree must not fail the measurement
+        os.makedirs(os.path.dirname(LAST_GOOD), exist_ok=True)
+        with open(LAST_GOOD, "w") as f:
+            json.dump({"measured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()), "res": res}, f)
+    except OSError:
+        pass
+
+
+def _load_last_good() -> dict | None:
+    try:
+        with open(LAST_GOOD) as f:
+            lg = json.load(f)
+        if lg.get("res", {}).get("pairs_per_sec_per_chip", 0) > 0:
+            return lg
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _vs_baseline(res: dict) -> float:
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_BASELINE.json")) as f:
+            base = json.load(f).get("pairs_per_sec_per_chip")
+        return res["pairs_per_sec_per_chip"] / base if base else 1.0
+    except Exception:  # noqa: BLE001 - missing/corrupt baseline: neutral
+        return 1.0
 
 
 # Third-party imports are deferred so the orchestrating parent stays
@@ -254,14 +312,18 @@ def calibrate(n: int = 4096, reps: int = 10) -> dict:
 
 def headline_setup(model_name: str = "inception_v3", batch: int = 16,
                    image_size=(320, 448), steps_per_call: int = 1,
-                   warp_impl: str | None = None):
+                   warp_impl: str | None = None, time_step: int = 2,
+                   weights: tuple = (16, 8, 4, 2, 1, 1)):
     """The headline workload, shared with tools/perf_probe.py so the
     decomposition there always measures the same config as the headline.
 
     With steps_per_call = K > 1 the returned step takes K stacked batches
     ([K, B, ...]) and the returned sharded batch is stacked accordingly
     (the perf_probe dispatch-amortization sweep). warp_impl overrides
-    `LossConfig.warp_impl` (None = the config default).
+    `LossConfig.warp_impl` (None = the config default). time_step > 2
+    builds the multi-frame T-volume variant (2(T-1) flow channels, 3T
+    input channels — the probe's Sintel-shaped section) on the same
+    pipeline, so multiframe timings share every other headline setting.
 
     Returns (cfg, mesh, ds, model, state, step, sharded_batch)."""
     _import_compute()
@@ -279,17 +341,19 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     cfg = ExperimentConfig(
         name="bench",
         model=model_name,
-        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1), **loss_kw),
+        loss=LossConfig(weights=tuple(weights), **loss_kw),
         optim=OptimConfig(learning_rate=1.6e-5),
         data=DataConfig(dataset="synthetic", image_size=(h, w), gt_size=(h, w),
-                        batch_size=batch),
+                        batch_size=batch, time_step=time_step),
         train=TrainConfig(seed=0, compute_dtype="bfloat16",
                           steps_per_call=steps_per_call),
     )
     mesh = build_mesh(cfg.mesh)
-    model = build_model(cfg.model, dtype=jnp.bfloat16)
+    model = build_model(cfg.model, flow_channels=2 * (time_step - 1),
+                        dtype=jnp.bfloat16)
     tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
-    state = create_train_state(model, jnp.zeros((batch, h, w, 6)), tx, seed=0)
+    state = create_train_state(
+        model, jnp.zeros((batch, h, w, 3 * time_step)), tx, seed=0)
     ds = SyntheticData(cfg.data)
     step = make_train_step(model, cfg, ds.mean, mesh)
     one = ds.sample_train(batch, iteration=0)
@@ -351,6 +415,9 @@ def step_flops(step, state, b) -> float | None:
         return None
 
 
+HEADLINE_CONFIG = ("inception_v3", 16, (320, 448))
+
+
 def bench(model_name: str = "inception_v3", batch: int = 16,
           image_size=(320, 448), steps: int = 20, warmup: int = 3,
           windows: int = 4) -> dict:
@@ -390,6 +457,14 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
             mfu_vs_matmul=round(model_tflops / max(res["matmul_tflops"], 1e-9),
                                 4),
         )
+    # Only the real headline measurement may become the orchestrator's
+    # stale-fallback value: a CLI bench of another model/batch, or a CPU
+    # smoke run, must not be reported later as the FlyingChairs-headline
+    # pairs/sec (the record carries no model/backend discriminator the
+    # reader could filter on).
+    if ((model_name, batch, tuple(image_size)) == HEADLINE_CONFIG
+            and jax.default_backend() == "tpu"):
+        _save_last_good(res)
     return res
 
 
@@ -407,20 +482,8 @@ def main(deadline_s: float | None = None) -> None:
     except TimeoutError as e:
         emit(0.0, 0.0, error=str(e))
         _exit(1)
-    vs = 1.0
-    try:
-        baseline_path = os.path.join(os.path.dirname(__file__),
-                                     "BENCH_BASELINE.json")
-        with open(baseline_path) as f:
-            base = json.load(f).get("pairs_per_sec_per_chip")
-        if base:
-            vs = res["pairs_per_sec_per_chip"] / base
-    except Exception:  # noqa: BLE001 - missing/corrupt baseline: still emit
-        vs = 1.0
-    extra = {k: res[k] for k in ("matmul_tflops", "rtt_ms", "batch",
-                                 "warp_impl", "model_tflops", "mfu_nominal",
-                                 "mfu_vs_matmul") if k in res}
-    emit(res["pairs_per_sec_per_chip"], vs, **extra)
+    extra = {k: res[k] for k in _EXTRA_KEYS if k in res}
+    emit(res["pairs_per_sec_per_chip"], _vs_baseline(res), **extra)
     _exit(0)
 
 

@@ -177,8 +177,12 @@ def main(argv=None) -> int:
         print(json.dumps({"written": written}))
         return 0
 
-    from .train.loop import Trainer
+    from .train.loop import Trainer, install_preemption_latch
 
+    if args.cmd == "train":
+        # before Trainer(): model build + first compile can take minutes,
+        # and a preemption SIGTERM in that window must still checkpoint
+        install_preemption_latch()
     trainer = Trainer(cfg, profile=getattr(args, "profile", False))
     if args.cmd == "train":
         out = trainer.fit(num_epochs=args.epochs, max_steps=args.max_steps)

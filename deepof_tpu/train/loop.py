@@ -31,6 +31,32 @@ from .state import create_train_state, make_optimizer
 from .step import make_eval_fn, make_train_step
 
 
+# Early-preemption latch (ADVICE r03): model build + the first TPU
+# compile can take minutes, and a SIGTERM landing before fit() installs
+# its graceful handler would hit the default action and kill the process
+# with no checkpoint. The CLI installs this minimal latch at entry; fit()
+# takes over and converts a latched signal into an immediate
+# save-and-stop (the loop exits before its first step, and the normal
+# finalize path writes the checkpoint). Same escalation contract as the
+# fit() handler: a SECOND signal restores the default action and
+# re-raises, so a run wedged in compile stays killable.
+_EARLY_SIGTERM: dict[str, int | None] = {"sig": None}
+
+
+def install_preemption_latch() -> None:
+    def _latch(signum, frame):
+        if _EARLY_SIGTERM["sig"] is not None:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        _EARLY_SIGTERM["sig"] = signum
+
+    try:
+        signal.signal(signal.SIGTERM, _latch)
+    except ValueError:  # non-main thread: host runtime owns signals
+        pass
+
+
 def data_stream_rng(mesh, seed: int, start_step: int) -> np.random.RandomState:
     """Host data-sampling stream for a fit() beginning at start_step.
 
@@ -281,6 +307,13 @@ class Trainer:
             handler_installed = True
         except ValueError:
             pass
+        # A SIGTERM latched by install_preemption_latch() before this
+        # point (during model build / first compile) becomes an immediate
+        # save-and-stop: the loop below exits before its first step and
+        # the finalize path writes the checkpoint.
+        if _EARLY_SIGTERM["sig"] is not None:
+            stop_sig["sig"] = _EARLY_SIGTERM["sig"]
+            _EARLY_SIGTERM["sig"] = None
         try:
             total_steps = (num_epochs or cfg.train.num_epochs) * self.steps_per_epoch
             if max_steps is not None:

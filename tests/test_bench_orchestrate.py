@@ -39,6 +39,8 @@ def _wire(monkeypatch, tmp_path, alive, run):
     clock = _Clock()
     monkeypatch.setattr(bench, "time", clock)
     monkeypatch.setattr(bench, "PROBE_LOG", str(tmp_path / "probes.log"))
+    # isolate from any real artifacts/last_good_bench.json on this tree
+    monkeypatch.setattr(bench, "LAST_GOOD", str(tmp_path / "last_good.json"))
     monkeypatch.setattr(bench, "_tunnel_alive",
                         lambda timeout_s=120.0: clock.sleep(5) or alive())
     monkeypatch.setattr(
@@ -130,9 +132,10 @@ def test_child_error_line_is_not_relayed_as_success(monkeypatch, capsys,
     assert "backend init exceeded" in lines[0]["error"]
 
 
-def test_warp_impl_deriisk_ladder_env(monkeypatch, capsys, tmp_path):
+def test_warp_impl_derisk_ladder_env(monkeypatch, capsys, tmp_path):
     """Attempts 1-2 run the default (BENCH_WARP_IMPL=''), attempts 3+
-    force 'xla'; an operator-exported value pins every attempt."""
+    force 'xla'; an operator-exported value pins every attempt —
+    including an exported *empty* value (pins the config default)."""
     seen = []
 
     def run(cmd, timeout, capture_output, text, env):
@@ -153,3 +156,51 @@ def test_warp_impl_deriisk_ladder_env(monkeypatch, capsys, tmp_path):
         bench.orchestrate(deadline_s=1600)
     capsys.readouterr()
     assert seen and set(seen) == {"xla"}
+
+    seen.clear()
+    monkeypatch.setenv("BENCH_WARP_IMPL", "")  # present-but-empty: pinned
+    _wire(monkeypatch, tmp_path, lambda: True, run)
+    with pytest.raises(SystemExit):
+        bench.orchestrate(deadline_s=1600)
+    capsys.readouterr()
+    assert len(seen) >= 3 and set(seen) == {""}
+
+
+def test_exhaustion_falls_back_to_last_good(monkeypatch, capsys, tmp_path):
+    """With no live window but a chain-captured measurement on disk, the
+    orchestrator reports that number marked stale instead of a blind 0.0
+    (VERDICT r03 item 1c)."""
+    def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
+        raise AssertionError("child must not run when tunnel is down")
+
+    _wire(monkeypatch, tmp_path, lambda: False, run)
+    (tmp_path / "last_good.json").write_text(json.dumps({
+        "measured_at": "2026-07-31T04:00:00Z",
+        "res": {"pairs_per_sec_per_chip": 241.7, "matmul_tflops": 63.4,
+                "rtt_ms": 67.0, "batch": 16, "warp_impl": "auto",
+                "mfu_nominal": 0.11, "mfu_vs_matmul": 0.33}}))
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=700)
+    assert e.value.code == 0
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1
+    assert lines[0]["value"] == 241.7
+    assert lines[0]["stale"] is True
+    assert lines[0]["measured_at"] == "2026-07-31T04:00:00Z"
+    assert lines[0]["mfu_nominal"] == 0.11
+    assert "error" in lines[0]  # the outage story still travels
+
+
+def test_exhaustion_ignores_empty_or_zero_last_good(monkeypatch, capsys,
+                                                    tmp_path):
+    def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
+        raise AssertionError("child must not run when tunnel is down")
+
+    _wire(monkeypatch, tmp_path, lambda: False, run)
+    (tmp_path / "last_good.json").write_text(json.dumps({
+        "measured_at": "T", "res": {"pairs_per_sec_per_chip": 0.0}}))
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=700)
+    assert e.value.code == 1
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1 and lines[0]["value"] == 0.0

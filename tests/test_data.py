@@ -228,10 +228,12 @@ def test_synthetic_blobs_style_consistency():
 
 def test_synthetic_affine_style_consistency():
     """The affine style's spatially varying GT field keeps the loss
-    contract: backward_warp(target, flow) reconstructs the source up to
-    cv2.remap's fixed-point bilinear quantization (INTER_LINEAR uses
-    5-bit fractional weights, so ~1/32 of the local dynamic range —
-    values are 0..255, hence the ~2-gray-level tolerance)."""
+    contract: backward_warp(target, flow) reconstructs the source.
+    For float32 input cv2's INTER_LINEAR uses float weights (its 5-bit
+    fixed-point tables apply only to uint8), so interior pixels agree to
+    float rounding; the m=4 crop excludes the border rows where
+    backward_warp's clip-at-border convention and remap's border mode
+    legitimately differ. Measured max |err| over 10 draws: ~5e-5."""
     from deepof_tpu.ops.warp import backward_warp
 
     cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=2)
@@ -244,7 +246,7 @@ def test_synthetic_affine_style_consistency():
     recon = np.asarray(backward_warp(b["target"], b["flow"]))
     m = 4
     np.testing.assert_allclose(recon[:, m:-m, m:-m],
-                               b["source"][:, m:-m, m:-m], atol=2.0)
+                               b["source"][:, m:-m, m:-m], atol=1e-3)
     b2 = ds.sample_train(2, iteration=0)
     np.testing.assert_array_equal(b["source"], b2["source"])
 

@@ -426,6 +426,34 @@ def test_transfer_init_chairs_to_sintel_shapes(tmp_path):
     assert tp["decoder"]["pr1"]["Conv_0"]["kernel"].shape[-1] == 6
 
 
+def test_early_sigterm_latch_stops_before_first_step(tmp_path):
+    """ADVICE r03: a SIGTERM during the unprotected window (model build /
+    first compile, before fit() installs its handler) must still end in a
+    clean checkpoint. The CLI installs `install_preemption_latch()` at
+    entry; a latched signal makes fit() exit before its first step and
+    run the normal finalize path."""
+    import os as _os
+    import signal as _signal
+
+    from deepof_tpu.train import loop as loop_mod
+
+    prev = _signal.getsignal(_signal.SIGTERM)
+    loop_mod.install_preemption_latch()
+    try:
+        _os.kill(_os.getpid(), _signal.SIGTERM)  # latched, not fatal
+        assert loop_mod._EARLY_SIGTERM["sig"] == _signal.SIGTERM
+        trainer = Trainer(_cfg(tmp_path), profile=False)
+        trainer.fit(num_epochs=1, max_steps=10)
+        # no step ran (the latch converted to an immediate stop) and the
+        # finalize path still wrote a resumable checkpoint
+        assert int(trainer.state.step) == 0
+        assert trainer.ckpt.latest_step() is not None
+        assert loop_mod._EARLY_SIGTERM["sig"] is None  # consumed
+    finally:
+        _signal.signal(_signal.SIGTERM, prev)
+        loop_mod._EARLY_SIGTERM["sig"] = None
+
+
 @pytest.mark.slow
 def test_sigterm_graceful_checkpoint(tmp_path):
     """Preemption handling (SURVEY.md §5.3): SIGTERM mid-training ends the

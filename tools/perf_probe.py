@@ -7,16 +7,22 @@ Honest (value-fetch) timings; see DESIGN.md "Benchmark honesty" for why
     python tools/perf_probe.py --no-wait       # fail fast if tunnel down
     python tools/perf_probe.py --only warp,decomp   # named sections
 
-Sections (in the order a short tunnel window should spend them):
+Sections (in the order a short tunnel window should spend them —
+VERDICT r03 item 1: the driver-visible number FIRST, context after):
+  headline bench.py headline (value + MFU fields; also persists
+           artifacts/last_good_bench.json for the orchestrator's
+           last-known-good fallback)
   calib    raw matmul TFLOP/s + RTT (tunnel-condition context)
   decomp   Inception-v3 train-step decomposition (fwd / fwd+loss /
            +bwd / full step, and the pyramid-loss/warp share)
   warpscan device-honest warp timing: 20 warps chained inside one jit
            (per-call dispatch floor amortized away), incl. the finest
            160x224 level — supersedes `warp` for decisions
-  spc      steps_per_call sweep (1/2/4/8): dispatch+RTT amortization
-  batch    batch-size throughput curve (16/32/64/96)
-  headline bench.py headline (value + MFU fields)
+  spc      steps_per_call sweep (1/4/8): dispatch+RTT amortization
+  corr     XLA vs Pallas correlation kernel, fwd + grad, FlowNet-C
+           shapes (VERDICT r03 item 4: time it or demote it)
+  batch    batch-size throughput curve (16/96)
+  multiframe Sintel-shaped T=10 volume train step (VERDICT r03 item 7)
   warp     per-call XLA vs Pallas warp table (dispatch-contaminated on
            a high-RTT tunnel; kept for cross-window comparability)
 """
@@ -267,17 +273,65 @@ def sec_headline() -> None:
                      for k, v in res.items()}, flush=True)
 
 
-# Execution order = priority order for a short tunnel window: the
-# decomposition (before/after for each landed optimization) first, then
-# the device-honest warp scan, then the dispatch-amortization sweeps;
-# the per-call warp table is superseded by warpscan and runs last.
+def sec_corr() -> None:
+    """XLA sweep vs Pallas correlation kernel at the FlowNet-C shapes
+    (320x448 input -> conv3 features 40x56x256, 441 displacement maps).
+    Each impl is timed independently so a Pallas compile failure on the
+    real backend still leaves the XLA row (a measured demotion verdict
+    rather than a dead section)."""
+    import jax
+
+    from deepof_tpu.ops.corr import correlation
+
+    key = jax.random.PRNGKey(0)
+    f1 = jax.random.normal(key, (16, 40, 56, 256)) * 0.1
+    f2 = jax.random.normal(jax.random.PRNGKey(1), (16, 40, 56, 256)) * 0.1
+    for impl in ("xla", "pallas"):
+        try:
+            f = jax.jit(lambda a, b, impl=impl:
+                        correlation(a, b, impl=impl).sum())
+            timeit(f"corr fwd {impl} 40x56x256", f, f1, f2)
+            g = jax.jit(lambda a, b, impl=impl: sum(
+                x.sum() for x in jax.grad(
+                    lambda q: correlation(q[0], q[1], impl=impl).sum())((a, b))))
+            timeit(f"corr grad {impl} 40x56x256", g, f1, f2)
+        except Exception:  # noqa: BLE001 - one impl failing is itself data
+            import traceback
+            traceback.print_exc()
+            print(f"corr {impl} FAILED (see traceback)", flush=True)
+
+
+def sec_multiframe() -> None:
+    """Sintel-shaped multi-frame step: Inception-v3, T=10 volume
+    (B,224,480,30), 18 flow channels, batch 4 — the reference Sintel
+    recipe (`deepOF.py:13-16`, crop 224x480, SURVEY §2.2). Closes the
+    time-axis perf gap (VERDICT r03 item 7): the T-volume path is
+    dryrun-validated but had zero on-chip timing. Built through
+    bench.headline_setup so it shares every other headline setting."""
+    t, batch = 10, 4
+    cfg, mesh, ds, model, state, step, b = bench_mod.headline_setup(
+        batch=batch, image_size=(224, 480), time_step=t,
+        weights=(16, 8, 4, 4, 2, 1))
+    per, _ = _time_full_step(step, state, b, steps=6, windows=2)
+    pairs = batch * (t - 1)  # T-1 consecutive warped pairs per item
+    print(f"{'sintel T=10 full step b=4 224x480':44s} {per*1e3:8.2f} ms  "
+          f"{batch/per:9.1f} items/s  {pairs/per:9.1f} pairs/s", flush=True)
+
+
+# Execution order = priority order for a short tunnel window (VERDICT
+# r03 item 1b): the driver-visible headline + its MFU fields FIRST, then
+# calibration context, then the decision sections (decomp/warpscan/spc/
+# corr), then sweeps; the per-call warp table is superseded by warpscan
+# and runs last.
 SECTIONS = {
+    "headline": sec_headline,
     "calib": sec_calib,
     "decomp": sec_decomp,
     "warpscan": sec_warp_scan,
     "spc": sec_spc,
+    "corr": sec_corr,
     "batch": sec_batch,
-    "headline": sec_headline,
+    "multiframe": sec_multiframe,
     "warp": sec_warp,
 }
 
@@ -319,7 +373,7 @@ def main() -> None:
         # the chain retrying (re-timing already-passed sections is cheap
         # with the persistent compile cache). calib/batch/warp are
         # context, not decisions — their failure alone doesn't retry.
-        required = {"decomp", "warpscan", "spc", "headline"}
+        required = {"decomp", "warpscan", "spc", "headline", "corr"}
         if required.intersection(failed):
             raise SystemExit(1)
 

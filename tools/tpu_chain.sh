@@ -1,16 +1,19 @@
 #!/bin/sh
 # TPU-gated measurement chain. Left running in the background, it waits
 # for a live tunnel window (perf_probe's own subprocess-probe wait loop)
-# and then spends it in priority order (VERDICT r02 items 1/2/3/5/6):
-#   1. perf_probe ALL sections (calib, step decomposition, warp
-#      XLA-vs-Pallas, batch + steps_per_call sweeps, headline)
+# and then spends it in priority order (VERDICT r03 items 1/2/3/4/7):
+#   1. perf_probe ALL sections — headline (+ last_good_bench.json for
+#      the orchestrator fallback) FIRST, then calib, decomp, warpscan,
+#      spc, corr, batch, multiframe, warp
 #   2. synthetic_fit on the real chip to < 1 px held-out EPE
+#      (dense-canvas config — the sparse default provably stalls in an
+#      aperture basin, DESIGN.md)
 # Each stage re-execs on failure (a wedge between the subprocess probe
 # and main-process init aborts that attempt; only that process is lost).
 # All output lands under artifacts/ with timestamps.
 cd "$(dirname "$0")/.." || exit 1
-PLOG=artifacts/perf_probe_r03.log
-FLOG=artifacts/synthetic_fit_tpu_run.log
+PLOG=artifacts/perf_probe_r04.log
+FLOG=artifacts/synthetic_fit_tpu_run_r04.log
 
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
