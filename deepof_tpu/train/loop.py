@@ -40,7 +40,7 @@ from .step import make_eval_fn, make_train_step
 # finalize path writes the checkpoint). Same escalation contract as the
 # fit() handler: a SECOND signal restores the default action and
 # re-raises, so a run wedged in compile stays killable.
-_EARLY_SIGTERM: dict[str, int | None] = {"sig": None}
+_EARLY_SIGTERM: dict = {"sig": None, "handler": None}
 
 
 def install_preemption_latch() -> None:
@@ -51,6 +51,11 @@ def install_preemption_latch() -> None:
             return
         _EARLY_SIGTERM["sig"] = signum
 
+    # remembered so fit()'s handler restore can recognize the latch and
+    # NOT re-install it after training: post-fit (checkpoint already
+    # committed) a SIGTERM must kill the process, not be swallowed into
+    # a flag nobody reads anymore
+    _EARLY_SIGTERM["handler"] = _latch
     try:
         signal.signal(signal.SIGTERM, _latch)
     except ValueError:  # non-main thread: host runtime owns signals
@@ -445,11 +450,15 @@ class Trainer:
             # must stay protected by the graceful handler. A C-level
             # previous handler cannot be re-installed from Python
             # (signal.signal returned None for it) — fall back to SIG_DFL
-            # so the process at least stays killable.
+            # so the process at least stays killable. The early preemption
+            # latch is likewise NOT restored: its only job was protecting
+            # the pre-fit window, and re-arming it would silently swallow
+            # the first SIGTERM after training completes.
             if handler_installed:
-                signal.signal(signal.SIGTERM,
-                              prev_handler if prev_handler is not None
-                              else signal.SIG_DFL)
+                restore = prev_handler
+                if restore is None or restore is _EARLY_SIGTERM.get("handler"):
+                    restore = signal.SIG_DFL
+                signal.signal(signal.SIGTERM, restore)
         rates = timer.rates()
         return {**last_eval, **rates}
 

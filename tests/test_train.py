@@ -449,6 +449,9 @@ def test_early_sigterm_latch_stops_before_first_step(tmp_path):
         assert int(trainer.state.step) == 0
         assert trainer.ckpt.latest_step() is not None
         assert loop_mod._EARLY_SIGTERM["sig"] is None  # consumed
+        # post-fit the latch must NOT be re-armed: a SIGTERM after the
+        # final checkpoint is committed should kill, not be swallowed
+        assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
     finally:
         _signal.signal(_signal.SIGTERM, prev)
         loop_mod._EARLY_SIGTERM["sig"] = None
