@@ -196,6 +196,11 @@ class TrainConfig:
 class ExperimentConfig:
     name: str = "flyingchairs_flownet_s"
     model: str = "flownet_s"  # flownet_s|vgg16|inception_v3|flownet_c|st_single|st_baseline
+    # Thin-variant channel multiplier — currently honored by flownet_s
+    # only (the parity backbones keep their exact reference widths).
+    # 1.0 = reference widths; the test suite uses 0.25 so full-train-step
+    # wiring checks don't pay 38M-param compute on the CPU mesh.
+    width_mult: float = 1.0
     loss: LossConfig = field(default_factory=LossConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     data: DataConfig = field(default_factory=DataConfig)

@@ -137,29 +137,40 @@ class FlowDecoder(nn.Module):
         return flows
 
 
-def flownet_tail(x, dtype: Dtype = jnp.float32, prefix: str = "conv"):
+def scaled_width(features: int, mult: float) -> int:
+    """Channel width under a width multiplier (thin model variants); floor
+    of 8 keeps every layer viable at small multipliers."""
+    return max(int(features * mult), 8)
+
+
+def flownet_tail(x, dtype: Dtype = jnp.float32, prefix: str = "conv",
+                 width_mult: float = 1.0):
     """conv4_1..conv6_2 contracting tail (strides 2 at 4_1/5_1/6_1); returns
     (conv4_2, conv5_2, conv6_2). Called inside a parent @nn.compact so the
     layer names land in the caller's scope. Shared by FlowNet-S, FlowNet-C,
     and STBaseline's temporal trunk."""
-    c4_1 = ConvELU(512, stride=2, dtype=dtype, name=f"{prefix}4_1")(x)
-    c4_2 = ConvELU(512, dtype=dtype, name=f"{prefix}4_2")(c4_1)
-    c5_1 = ConvELU(512, stride=2, dtype=dtype, name=f"{prefix}5_1")(c4_2)
-    c5_2 = ConvELU(512, dtype=dtype, name=f"{prefix}5_2")(c5_1)
-    c6_1 = ConvELU(1024, stride=2, dtype=dtype, name=f"{prefix}6_1")(c5_2)
-    c6_2 = ConvELU(1024, dtype=dtype, name=f"{prefix}6_2")(c6_1)
+    ch = lambda n: scaled_width(n, width_mult)  # noqa: E731
+    c4_1 = ConvELU(ch(512), stride=2, dtype=dtype, name=f"{prefix}4_1")(x)
+    c4_2 = ConvELU(ch(512), dtype=dtype, name=f"{prefix}4_2")(c4_1)
+    c5_1 = ConvELU(ch(512), stride=2, dtype=dtype, name=f"{prefix}5_1")(c4_2)
+    c5_2 = ConvELU(ch(512), dtype=dtype, name=f"{prefix}5_2")(c5_1)
+    c6_1 = ConvELU(ch(1024), stride=2, dtype=dtype, name=f"{prefix}6_1")(c5_2)
+    c6_2 = ConvELU(ch(1024), dtype=dtype, name=f"{prefix}6_2")(c6_1)
     return c4_2, c5_2, c6_2
 
 
-def flownet_trunk(x, dtype: Dtype = jnp.float32, prefix: str = "conv"):
+def flownet_trunk(x, dtype: Dtype = jnp.float32, prefix: str = "conv",
+                  width_mult: float = 1.0):
     """Full 10-conv FlowNet-S contracting trunk
     (`flyingChairsWrapFlow.py:31-40`); returns decoder taps coarsest-last:
-    [conv1, conv2, conv3_2, conv4_2, conv5_2, conv6_2]."""
-    c1 = ConvELU(64, (7, 7), 2, dtype=dtype, name=f"{prefix}1")(x)
-    c2 = ConvELU(128, (5, 5), 2, dtype=dtype, name=f"{prefix}2")(c1)
-    c3_1 = ConvELU(256, (5, 5), 2, dtype=dtype, name=f"{prefix}3_1")(c2)
-    c3_2 = ConvELU(256, dtype=dtype, name=f"{prefix}3_2")(c3_1)
-    c4_2, c5_2, c6_2 = flownet_tail(c3_2, dtype, prefix)
+    [conv1, conv2, conv3_2, conv4_2, conv5_2, conv6_2]. width_mult < 1
+    builds the thin variant (same topology, scaled channels)."""
+    ch = lambda n: scaled_width(n, width_mult)  # noqa: E731
+    c1 = ConvELU(ch(64), (7, 7), 2, dtype=dtype, name=f"{prefix}1")(x)
+    c2 = ConvELU(ch(128), (5, 5), 2, dtype=dtype, name=f"{prefix}2")(c1)
+    c3_1 = ConvELU(ch(256), (5, 5), 2, dtype=dtype, name=f"{prefix}3_1")(c2)
+    c3_2 = ConvELU(ch(256), dtype=dtype, name=f"{prefix}3_2")(c3_1)
+    c4_2, c5_2, c6_2 = flownet_tail(c3_2, dtype, prefix, width_mult)
     return [c1, c2, c3_2, c4_2, c5_2, c6_2]
 
 

@@ -17,7 +17,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .common import FlowDecoder, flownet_trunk
+from .common import FlowDecoder, flownet_trunk, scaled_width
 
 FLOW_SCALES = (10.0, 5.0, 2.5, 1.25, 0.625, 0.3125)  # finest (pr1) first
 
@@ -25,15 +25,20 @@ FLOW_SCALES = (10.0, 5.0, 2.5, 1.25, 0.625, 0.3125)  # finest (pr1) first
 class FlowNetS(nn.Module):
     flow_channels: int = 2
     dtype: Any = jnp.float32
+    # Thin-variant channel multiplier (same topology / flow semantics,
+    # scaled widths — the standard FlowNet "/N" family). 1.0 = exact
+    # reference widths; tests use 0.25 for cheap wiring checks.
+    width_mult: float = 1.0
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
     max_downsample = 64  # six stride-2 stages; spatial-CP gradient-safety bound
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
-        taps = flownet_trunk(x, self.dtype)
+        taps = flownet_trunk(x, self.dtype, width_mult=self.width_mult)
         flows = FlowDecoder(
-            upconv_features=(512, 256, 128, 64, 32),
+            upconv_features=tuple(scaled_width(f, self.width_mult)
+                                  for f in (512, 256, 128, 64, 32)),
             flow_channels=self.flow_channels,
             dtype=self.dtype,
             name="decoder",

@@ -27,10 +27,23 @@ MODELS = {
 }
 
 
-def build_model(name: str, flow_channels: int = 2, dtype: Any = jnp.float32, **kw):
+def build_model(name: str, flow_channels: int = 2, dtype: Any = jnp.float32,
+                width_mult: float = 1.0, **kw):
     if name not in MODELS:
         raise KeyError(f"unknown model {name!r}; available: {sorted(MODELS)}")
     cls = MODELS[name]
+    if width_mult != 1.0:
+        # honored only by models that declare the field (flownet_s); the
+        # parity backbones keep exact reference widths — reject with a
+        # named error instead of a dataclass TypeError deep in __init__
+        import dataclasses
+
+        if "width_mult" not in {f.name for f in dataclasses.fields(cls)}:
+            raise ValueError(
+                f"model {name!r} does not support width_mult "
+                f"(={width_mult}); only models with a width_mult field "
+                "(flownet_s) build thin variants")
+        kw["width_mult"] = width_mult
     if name == "ucf101_spatial":
         return cls(dtype=dtype, **kw)
     return cls(flow_channels=flow_channels, dtype=dtype, **kw)

@@ -39,6 +39,7 @@ reproduces the reference's value AND its (zero) flow gradient exactly.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 #: impl="auto" routes to the Pallas kernel when W <= 128 (the kernel's
@@ -68,8 +69,15 @@ def backward_warp(image: jnp.ndarray, flow: jnp.ndarray,
     choice and the `LossConfig.warp_impl` default).
     """
     b, h, w, c = image.shape
+    # "auto" = the measured-fastest choice, and the measurement is a TPU
+    # measurement: off-TPU the kernel only exists in interpret mode
+    # (python-level emulation, ~10-100x slower than the XLA gather — it
+    # silently dominated the CPU-mesh test suite's runtime before this
+    # gate). Explicit impl="pallas" still honors the request anywhere,
+    # which is what the kernel's correctness tests use.
     if impl == "pallas" or (impl == "auto" and w <= PALLAS_AUTO_MAX_W
-                            and h <= PALLAS_AUTO_MAX_H):
+                            and h <= PALLAS_AUTO_MAX_H
+                            and jax.default_backend() == "tpu"):
         from .pallas.warp import backward_warp_pallas
 
         return backward_warp_pallas(image, flow)

@@ -33,7 +33,8 @@ def restore_params(cfg: ExperimentConfig):
     from .train.state import create_train_state, make_optimizer
 
     t = cfg.data.time_step
-    model = build_model(cfg.model, flow_channels=2 * (t - 1))
+    model = build_model(cfg.model, flow_channels=2 * (t - 1),
+                        width_mult=cfg.width_mult)
     h, w = cfg.data.image_size  # eval-protocol resolution (val is uncropped)
     tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
     template = create_train_state(

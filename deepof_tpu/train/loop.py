@@ -94,7 +94,8 @@ class Trainer:
         flow_channels = 2 * (t - 1)
         dtype = (jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16"
                  else jnp.float32)
-        self.model = build_model(cfg.model, flow_channels=flow_channels, dtype=dtype)
+        self.model = build_model(cfg.model, flow_channels=flow_channels,
+                                 dtype=dtype, width_mult=cfg.width_mult)
 
         self.logger = MetricsLogger(cfg.train.log_dir)
         self.profiler = ProfilerSession(cfg.train.log_dir, enabled=profile)

@@ -50,6 +50,20 @@ def test_flownet_s_multiframe_channels():
     assert all(f.shape[-1] == 18 for f in flows)
 
 
+def test_flownet_s_width_mult_thin_variant():
+    """width_mult scales channels, not topology: same pyramid shapes and
+    flow semantics, ~width_mult^2 of the parameters (the knob the slow
+    tier's wiring tests rely on for cheap full-train-step compute)."""
+    model = FlowNetS(width_mult=0.25)
+    x = jnp.zeros((2, H, W, 6))
+    variables, flows = _init_apply(model, x)
+    assert len(flows) == 6
+    for k, f in enumerate(flows):
+        assert f.shape == (2, H >> (k + 1), W >> (k + 1), 2), (k, f.shape)
+    n_thin = count_params(variables["params"])
+    assert n_thin < 4e6  # ~38M * 0.0625 plus floor-of-8 layers
+
+
 def test_vgg16_pyramid():
     model = VGG16Flow()
     x = jnp.zeros((1, H, W, 6))

@@ -95,7 +95,10 @@ def test_two_process_dcn_path(tmp_path):
         # gloo's rendezvous has a hard 30s deadline; on this single-core
         # host a contended scheduler (full suite + background jobs) can
         # blow it transiently. Retry once — a deterministic failure fails
-        # both attempts.
+        # both attempts. (A longer rendezvous timeout would be preferable,
+        # but jaxlib's make_gloo_tcp_collectives exposes only
+        # hostname/interface — the 30s kv-store deadline is baked into the
+        # C++ wrapper, checked jax 0.9: no Python-reachable knob.)
         rcs, outs = _run_two_process(tmp_path)
     for rc, out in zip(rcs, outs):
         assert rc == 0, f"worker failed:\n{out[-3000:]}"

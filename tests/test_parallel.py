@@ -38,6 +38,7 @@ def _cfg(mesh_cfg: MeshConfig, height: int = H, batch: int = 8,
     data.update(data_kw)
     return ExperimentConfig(
         model="flownet_s",
+        width_mult=0.25,  # thin trunk: CP/halo semantics are width-free
         loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
         optim=OptimConfig(learning_rate=1e-4),
         data=DataConfig(**data),
@@ -53,7 +54,7 @@ def _run_one_step(mesh_cfg: MeshConfig, time_step: int = 2,
     mesh = build_mesh(cfg.mesh)
     ds = SyntheticData(cfg.data)
     t = cfg.data.time_step
-    model = build_model("flownet_s", flow_channels=2 * (t - 1))
+    model = build_model("flownet_s", flow_channels=2 * (t - 1), width_mult=0.25)
     tx = make_optimizer(cfg.optim, lambda s: 1e-4)
     state = create_train_state(model, jnp.zeros((batch, height, W, 3 * t)),
                                tx, seed=0)

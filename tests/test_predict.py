@@ -18,6 +18,7 @@ def test_predict_cli_roundtrip(tmp_path):
     log_dir = str(tmp_path / "run")
     rc = cli_main([
         "train", "--preset", "flyingchairs", "--model", "flownet_s",
+        "--set", "width_mult=0.25",  # thin trunk, see test_train._cfg
         "--synthetic", "--steps", "2", "--log-dir", log_dir,
     ])
     assert rc == 0
@@ -33,6 +34,7 @@ def test_predict_cli_roundtrip(tmp_path):
     out_dir = str(tmp_path / "out")
     rc = cli_main([
         "predict", "--preset", "flyingchairs", "--model", "flownet_s",
+        "--set", "width_mult=0.25",
         "--synthetic", "--log-dir", log_dir, "--out", out_dir,
         "--pairs", f"{prev}:{nxt}",
     ])
