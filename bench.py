@@ -151,20 +151,24 @@ def orchestrate(deadline_s: float | None = None) -> None:
         remaining = deadline_s - (time.time() - t_start)
         child_budget = max(min(remaining - 30.0, 900.0), min_child_budget)
         attempts += 1
-        # De-risk ladder: first two child attempts run the measured-fastest
-        # default (warp_impl=auto incl. Pallas kernels); from the third on,
-        # force the pure-XLA warp in case the failure is a kernel-in-step
-        # compile problem rather than the tunnel. An operator-exported
-        # BENCH_WARP_IMPL pins every attempt instead — including
-        # BENCH_WARP_IMPL="" (present-but-empty pins the config default
-        # for all attempts; only truly-unset engages the ladder).
+        # De-risk ladder: attempt 1 runs the full measured-fastest config
+        # (warp_impl=auto incl. Pallas kernels, steps_per_call=4 to
+        # amortize the ~67 ms tunnel RTT); attempt 2 drops back to
+        # steps_per_call=1 (in case the K-step scan is the compile
+        # problem); attempt 3+ additionally forces the pure-XLA warp. An
+        # operator-exported BENCH_WARP_IMPL / BENCH_SPC pins that knob for
+        # every attempt instead — including BENCH_WARP_IMPL="" (present-
+        # but-empty pins the config default; only truly-unset engages the
+        # ladder).
         warp = (os.environ["BENCH_WARP_IMPL"]
                 if "BENCH_WARP_IMPL" in os.environ
                 else ("" if attempts <= 2 else "xla"))
+        spc = (os.environ["BENCH_SPC"] if "BENCH_SPC" in os.environ
+               else ("4" if attempts <= 1 else "1"))
         _plog(f"child attempt={attempts} budget={child_budget:.0f}s"
-              + (f" warp_impl={warp}" if warp else ""))
+              + (f" warp_impl={warp}" if warp else "") + f" spc={spc}")
         env = dict(os.environ, BENCH_DEADLINE_S=str(child_budget - 20.0),
-                   BENCH_WARP_IMPL=warp)
+                   BENCH_WARP_IMPL=warp, BENCH_SPC=spc)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--run"],
@@ -216,7 +220,8 @@ def orchestrate(deadline_s: float | None = None) -> None:
 
 
 _EXTRA_KEYS = ("matmul_tflops", "rtt_ms", "batch", "warp_impl",
-               "model_tflops", "mfu_nominal", "mfu_vs_matmul")
+               "steps_per_call", "model_tflops", "mfu_nominal",
+               "mfu_vs_matmul")
 
 
 def _save_last_good(res: dict) -> None:
@@ -428,16 +433,29 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     # child attempts fall back to the pure-XLA warp instead of forfeiting
     # the round's number.
     warp_impl = os.environ.get("BENCH_WARP_IMPL") or None
+    # BENCH_SPC: K optimizer steps per dispatch (the Trainer's own
+    # steps_per_call lax.scan path). One dispatch + one value fetch then
+    # serves K steps, amortizing the per-step host/transport overhead
+    # that dominates on a ~67 ms-RTT tunnel. Throughput stays
+    # per-optimizer-step either way.
+    spc = max(int(os.environ.get("BENCH_SPC") or 1), 1)
     cfg, mesh, ds, model, state, step, b = headline_setup(
-        model_name, batch, image_size, warp_impl=warp_impl)
+        model_name, batch, image_size, steps_per_call=spc,
+        warp_impl=warp_impl)
 
-    per_step, state, total = time_train_step(
-        step, state, b, steps=steps, windows=windows, warmup=warmup)
+    # keep the per-attempt optimizer-step work roughly constant across
+    # spc values (each timed CALL runs K steps; without this, spc=4 would
+    # execute ~4x the work and push the attempt toward its child timeout)
+    calls = max(steps // spc, 5)
+    per_call, state, total = time_train_step(
+        step, state, b, steps=calls, windows=windows, warmup=warmup)
+    per_step = per_call / spc
     pairs_per_sec = batch / per_step
     per_chip = pairs_per_sec / n_chips
     assert np.isfinite(total).all(), total
     res = {"pairs_per_sec_per_chip": per_chip, "pairs_per_sec": pairs_per_sec,
            "n_chips": n_chips, "batch": batch, "steps_per_sec": 1.0 / per_step,
+           "steps_per_call": spc,
            "warp_impl": cfg.loss.warp_impl, **calibrate()}
     # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
     # nominal chip peak and the concurrently measured matmul rate (the
@@ -448,7 +466,10 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
         # verified: an 8-way-sharded einsum reports the full count from
         # .lower().cost_analysis() and 1/8 of it from
         # .compile().cost_analysis(). Per-chip rate therefore divides by
-        # n_chips.
+        # n_chips. No spc normalization: XLA counts a lax.scan body ONCE
+        # (verified on this jax: K=4 scan reports 528386 flops vs 528384
+        # for the single step), so the K-step program already reports
+        # per-step flops.
         model_tflops = flops * res["steps_per_sec"] / n_chips / 1e12
         res.update(
             flops_per_step=flops,

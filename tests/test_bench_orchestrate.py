@@ -133,13 +133,14 @@ def test_child_error_line_is_not_relayed_as_success(monkeypatch, capsys,
 
 
 def test_warp_impl_derisk_ladder_env(monkeypatch, capsys, tmp_path):
-    """Attempts 1-2 run the default (BENCH_WARP_IMPL=''), attempts 3+
-    force 'xla'; an operator-exported value pins every attempt —
-    including an exported *empty* value (pins the config default)."""
+    """Attempt 1 runs the full fast config (default warp, spc=4);
+    attempt 2 drops to spc=1; attempts 3+ also force 'xla'. An operator-
+    exported value pins that knob for every attempt — including an
+    exported *empty* BENCH_WARP_IMPL (pins the config default)."""
     seen = []
 
     def run(cmd, timeout, capture_output, text, env):
-        seen.append(env.get("BENCH_WARP_IMPL"))
+        seen.append((env.get("BENCH_WARP_IMPL"), env.get("BENCH_SPC")))
         monkeypatch.setattr(bench.time, "t", bench.time.t + 250)
         return types.SimpleNamespace(returncode=1, stdout="", stderr="x")
 
@@ -147,23 +148,27 @@ def test_warp_impl_derisk_ladder_env(monkeypatch, capsys, tmp_path):
     with pytest.raises(SystemExit):
         bench.orchestrate(deadline_s=1600)
     assert len(seen) >= 3
-    assert seen[0] == "" and seen[1] == "" and set(seen[2:]) == {"xla"}
+    assert seen[0] == ("", "4") and seen[1] == ("", "1")
+    assert set(seen[2:]) == {("xla", "1")}
 
     seen.clear()
     monkeypatch.setenv("BENCH_WARP_IMPL", "xla")
+    monkeypatch.setenv("BENCH_SPC", "2")
     _wire(monkeypatch, tmp_path, lambda: True, run)
     with pytest.raises(SystemExit):
         bench.orchestrate(deadline_s=1600)
     capsys.readouterr()
-    assert seen and set(seen) == {"xla"}
+    assert seen and set(seen) == {("xla", "2")}
 
     seen.clear()
     monkeypatch.setenv("BENCH_WARP_IMPL", "")  # present-but-empty: pinned
+    monkeypatch.delenv("BENCH_SPC")
     _wire(monkeypatch, tmp_path, lambda: True, run)
     with pytest.raises(SystemExit):
         bench.orchestrate(deadline_s=1600)
     capsys.readouterr()
-    assert len(seen) >= 3 and set(seen) == {""}
+    assert len(seen) >= 3 and {w for w, _ in seen} == {""}
+    assert [s for _, s in seen[:2]] == ["4", "1"]  # spc ladder still live
 
 
 def test_exhaustion_falls_back_to_last_good(monkeypatch, capsys, tmp_path):
