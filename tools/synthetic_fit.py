@@ -53,6 +53,10 @@ def main() -> None:
     ap.add_argument("--fresh", action="store_true",
                     help="ignore and remove any existing checkpoint for "
                          "this --out instead of auto-resuming")
+    ap.add_argument("--width-mult", type=float, default=1.0,
+                    help="flownet_s thin-variant channel multiplier; the "
+                         "CPU hedge runs 0.25 (~16x cheaper steps), the "
+                         "TPU rungs keep the full reference widths")
     # Escalation levers (VERDICT r03 item 3): if the default recipe stalls
     # in a photometric basin, the chain's ladder ADDS these built quality
     # upgrades cumulatively so the artifacts record which added lever
@@ -117,7 +121,7 @@ def main() -> None:
     ds = SyntheticData(cfg.data, feature_scale=args.feature_scale,
                        max_shift=args.max_shift, style=args.style,
                        n_blobs=args.blobs)
-    model = build_model("flownet_s")
+    model = build_model("flownet_s", width_mult=args.width_mult)
 
     def schedule(s):
         if not args.lr_decay_every:
@@ -141,13 +145,18 @@ def main() -> None:
     fingerprint = {k: getattr(args, k) for k in (
         "lr", "lr_decay_every", "feature_scale", "max_shift", "style",
         "blobs", "batch", "photometric", "smoothness_order", "occlusion",
-        "lambda_smooth")}
+        "lambda_smooth", "width_mult")}
     fp_path = os.path.join(ckpt_dir, "config_fingerprint.json")
     if os.path.isdir(ckpt_dir):
         stale = args.fresh
         try:
             with open(fp_path) as fpf:
-                stale = stale or json.load(fpf) != fingerprint
+                loaded = json.load(fpf)
+            # schema tolerance: a lineage written before a knob existed
+            # has no key for it — treat missing keys as matching (the old
+            # run used the then-default) rather than wiping a 29k-step
+            # checkpoint over a fingerprint schema change
+            stale = stale or {**fingerprint, **loaded} != fingerprint
         except (OSError, ValueError):
             stale = True
         if stale:
@@ -205,6 +214,7 @@ def main() -> None:
             "max_shift": args.max_shift,
             "style": args.style,
             "blobs": args.blobs,
+            "width_mult": args.width_mult,
             "zero_flow_epe": round(zero_epe, 4),
             "loss": (f"{args.photometric}, canonical order="
                      f"{args.smoothness_order}, lambda="
