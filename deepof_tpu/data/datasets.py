@@ -489,11 +489,17 @@ class SyntheticData:
         # artifacts/synthetic_fit_long.jsonl). Densify for fitting runs.
         self._n_blobs = n_blobs
 
-    def _sample(self, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def _sample(self, seed: int, shift_bound: float | None = None
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """shift_bound overrides the DISPLACEMENT range only (curriculum
+        training, tools/synthetic_fit.py); canvas statistics (blob sigma)
+        always follow the constructor's max_shift so the train images
+        stay distributionally identical to eval. Integer-shift styles
+        quantize the bound to whole pixels (rounded)."""
         rng = np.random.RandomState(seed)
         h, w = self.cfg.image_size
         if self._style == "affine":
-            return self._sample_affine(rng, h, w)
+            return self._sample_affine(rng, h, w, shift_bound)
         if self._style == "blobs":
             img = self._blob_canvas(rng, h + 16, w + 16)
         else:
@@ -501,7 +507,9 @@ class SyntheticData:
             base = rng.rand(h // fs + 2, w // fs + 2, 3).astype(np.float32) * 255.0
             img = cv2.resize(base, (w + 16, h + 16),
                              interpolation=cv2.INTER_CUBIC)
-        u, v = rng.randint(-self._max_shift, self._max_shift + 1, 2)
+        bound = int(round(self._max_shift if shift_bound is None
+                          else shift_bound))
+        u, v = rng.randint(-bound, bound + 1, 2)
         src = img[8 : 8 + h, 8 : 8 + w]
         tgt = img[8 + v : 8 + v + h, 8 + u : 8 + u + w]
         # tgt[y, x] == src[y+v, x+u], so source content at p sits at
@@ -512,15 +520,18 @@ class SyntheticData:
         ).copy()
         return src, tgt, flow
 
-    def _sample_affine(self, rng, h: int, w: int):
+    def _sample_affine(self, rng, h: int, w: int,
+                       shift_bound: float | None = None):
         """Spatially varying exact-GT pair. GT field g = affine(p - c) + t,
-        rescaled so max |g| <= max_shift. Construction: the TARGET is the
-        blob canvas; the SOURCE is the exact bilinear backward warp of the
-        target by g (cv2.remap) — i.e. src[p] = tgt[p + g(p)] by
-        construction, which is precisely what the photometric loss's
-        reconstruction computes, so its minimizer is g and AEE-vs-g is an
-        exact learning metric (same convention as the shift styles:
-        tgt[p + flow] == src[p])."""
+        rescaled so max |g| <= max_shift (or the curriculum's shift_bound
+        override — displacement only, canvas untouched). Construction: the
+        TARGET is the blob canvas; the SOURCE is the exact bilinear
+        backward warp of the target by g (cv2.remap) — i.e.
+        src[p] = tgt[p + g(p)] by construction, which is precisely what
+        the photometric loss's reconstruction computes, so its minimizer
+        is g and AEE-vs-g is an exact learning metric (same convention as
+        the shift styles: tgt[p + flow] == src[p])."""
+        bound = self._max_shift if shift_bound is None else shift_bound
         tgt = self._blob_canvas(rng, h, w)
         yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
         cy, cx = rng.rand(2) * [h - 1, w - 1]
@@ -532,13 +543,13 @@ class SyntheticData:
                         [np.sin(ang), np.cos(ang)]], np.float32)
         a = a @ np.asarray([[scale, shear], [0.0, 1.0 / scale]], np.float32)
         a -= np.eye(2, dtype=np.float32)
-        tu, tv = (rng.rand(2) * 2 - 1) * self._max_shift * 0.5
+        tu, tv = (rng.rand(2) * 2 - 1) * bound * 0.5
         gu = a[0, 0] * (xx - cx) + a[0, 1] * (yy - cy) + tu
         gv = a[1, 0] * (xx - cx) + a[1, 1] * (yy - cy) + tv
         mag = float(np.sqrt(gu**2 + gv**2).max())
-        if mag > self._max_shift:
-            gu *= self._max_shift / mag
-            gv *= self._max_shift / mag
+        if mag > bound:
+            gu *= bound / mag
+            gv *= bound / mag
         gu = gu.astype(np.float32)  # tu/tv are python floats -> f64 maps
         gv = gv.astype(np.float32)
         src = cv2.remap(tgt, xx + gu, yy + gv, cv2.INTER_LINEAR,
@@ -563,8 +574,9 @@ class SyntheticData:
             img += blob[..., None] * color
         return np.clip(img, 0.0, 255.0).astype(np.float32)
 
-    def _batch(self, seeds) -> dict:
-        srcs, tgts, flows = zip(*(self._sample(int(s)) for s in seeds))
+    def _batch(self, seeds, shift_bound: float | None = None) -> dict:
+        srcs, tgts, flows = zip(*(self._sample(int(s), shift_bound)
+                                  for s in seeds))
         t = self.cfg.time_step
         out = {
             "source": np.stack(srcs),
@@ -578,13 +590,16 @@ class SyntheticData:
             out["flow"] = np.concatenate([out["flow"]] * (t - 1), axis=-1)
         return out
 
-    def sample_train(self, batch_size, iteration=None, rng=None):
+    def sample_train(self, batch_size, iteration=None, rng=None,
+                     max_shift: float | None = None):
+        """max_shift overrides the TRAIN displacement range only (shift
+        curriculum); canvases and the val split are unaffected."""
         if iteration is not None:
             seeds = [(iteration * batch_size + k) % self.num_train for k in range(batch_size)]
         else:
             rng = rng or np.random
             seeds = rng.randint(0, self.num_train, batch_size)
-        return self._batch(seeds)
+        return self._batch(seeds, shift_bound=max_shift)
 
     def sample_val(self, batch_size, batch_id):
         seeds = [self.num_train + (batch_id * batch_size + k) % self.num_val

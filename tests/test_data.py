@@ -251,6 +251,23 @@ def test_synthetic_affine_style_consistency():
     np.testing.assert_array_equal(b["source"], b2["source"])
 
 
+def test_synthetic_train_shift_override_keeps_canvas():
+    """The curriculum's sample_train(max_shift=...) override bounds the
+    DISPLACEMENT only: same seeds give byte-identical source canvases
+    (blob sigma follows the constructor's max_shift, not the override),
+    and sample_val ignores it entirely."""
+    cfg = DataConfig(dataset="synthetic", image_size=(32, 48), batch_size=4)
+    ds = SyntheticData(cfg, max_shift=4.0, style="blobs", n_blobs=20)
+    full = ds.sample_train(4, iteration=0)
+    curr = ds.sample_train(4, iteration=0, max_shift=1.0)
+    assert float(np.abs(full["flow"]).max()) == 4.0 or \
+        float(np.abs(full["flow"]).max()) <= 4.0  # bound holds
+    assert float(np.abs(curr["flow"]).max()) <= 1.0
+    np.testing.assert_array_equal(full["source"], curr["source"])
+    val_a = ds.sample_val(4, 0)
+    assert float(np.abs(val_a["flow"]).max()) <= 4.0
+
+
 def test_build_dataset_dispatch():
     cfg = DataConfig(dataset="synthetic", image_size=(16, 16))
     assert isinstance(build_dataset(cfg), SyntheticData)

@@ -17,6 +17,17 @@ FLOG=artifacts/synthetic_fit_tpu_run_r04.log
 
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
+# Single-instance guard: two chains would race the same artifact paths
+# (the fit stage rm's and rewrites per-rung jsonl + ckpt lineages) and
+# double-book the one TPU chip. Stale pidfiles (SIGKILL'd chain) are
+# reclaimed by the liveness check.
+LOCK=artifacts/.tpu_chain.pid
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null; then
+    echo "$(stamp) another chain (pid $(cat "$LOCK")) is running; exiting" >> "$PLOG"
+    exit 0
+fi
+echo $$ > "$LOCK"
+
 echo "$(stamp) chain start" >> "$PLOG"
 i=0
 while [ $i -lt 60 ]; do
@@ -34,11 +45,12 @@ done
 
 # Escalation ladder (VERDICT r03 item 3): dense canvas first (the
 # sparse default provably stalls in an aperture basin at ~3.9 px —
-# 12k-step CPU run, artifacts/synthetic_fit_long.jsonl; the 40-blob
-# probe shows the better trajectory). If a rung still stalls short of
-# 1 px, the next rung ADDS one built quality lever cumulatively
-# (census photometric, +occlusion masking, +second-order smoothness)
-# so the artifacts record which added lever cracked the basin.
+# 12k-step CPU run, artifacts/synthetic_fit_long.jsonl; the r04 CPU
+# rungs show the dense canvas alone does NOT fix it, and neither does
+# census — the diagnosed blocker is shifts beyond the finest levels'
+# photometric basin, DESIGN.md r04). Rung 2 is therefore the
+# diagnosis-driven shift curriculum; later rungs ADD one built quality
+# lever cumulatively so the artifacts record which lever mattered.
 FIT_ARGS_COMMON="--devices 0 --steps 30000 --eval-every 250 \
     --lr-decay-every 4000 --batch 16 --blobs 40"
 i=0
@@ -47,10 +59,11 @@ while [ $i -lt 20 ]; do
     i=$((i + 1))
     case $rung in
         1) extra=""; tag=default ;;
-        2) extra="--photometric census"; tag=census ;;
-        3) extra="--photometric census --occlusion"; tag=census_occ ;;
-        *) extra="--photometric census --occlusion --smoothness-order 2"
-           tag=order2 ;;
+        2) extra="--curriculum-steps 8000"; tag=curriculum ;;
+        3) extra="--curriculum-steps 8000 --photometric census"
+           tag=curr_census ;;
+        *) extra="--curriculum-steps 8000 --photometric census --occlusion"
+           tag=curr_census_occ ;;
     esac
     echo "$(stamp) synthetic_fit TPU attempt $i rung=$tag" >> "$FLOG"
     # probe first in a throwaway subprocess; the fit itself has no wait loop
