@@ -461,6 +461,11 @@ class SyntheticData:
     """
 
     mean = (0.0, 0.0, 0.0)
+    #: bumped whenever the procedural generator's output changes for the
+    #: same seed (e.g. the r04 multi-octave canvas rewrite = 2): fitting
+    #: tools fingerprint it so a checkpoint lineage never silently
+    #: resumes across a data-distribution change.
+    CANVAS_VERSION = 2
 
     def __init__(self, cfg: DataConfig, num_train: int = 64, num_val: int = 16,
                  max_shift: float = 4.0, feature_scale: int = 8,
@@ -558,20 +563,31 @@ class SyntheticData:
         return src.astype(np.float32), tgt, flow
 
     def _blob_canvas(self, rng, ch: int, cw: int) -> np.ndarray:
-        """Smooth linear-gradient background + sparse Gaussian blobs
-        (sigma ~ max_shift or wider): unambiguous structure whose local
-        autocorrelation peaks only at the true displacement."""
+        """Smooth linear-gradient background + MULTI-OCTAVE Gaussian blobs:
+        sigmas log-spaced from ~max_shift up to ~1/3 of the canvas, so the
+        image has structure at every pyramid scale — the property natural
+        images (1/f spectra) have and that coarse-to-fine estimation
+        depends on. Single-octave blobs (sigma ~ max_shift only, the
+        pre-r04 canvas) are invisible once downsampled 2-3 levels, which
+        left the coarse pyramid losses featureless and made shifts beyond
+        the finest levels' photometric basin unlearnable (DESIGN.md r04
+        item 6/7)."""
         yy, xx = np.mgrid[0:ch, 0:cw].astype(np.float32)
         gdir = rng.rand(2) * 2 - 1
         bg = 60.0 + 60.0 * (gdir[0] * yy / ch + gdir[1] * xx / cw + 1.0)
         img = np.repeat(bg[..., None], 3, axis=-1)
-        sigma = max(self._max_shift, 3.0)
+        s_lo = max(self._max_shift, 3.0)
+        s_hi = max(min(ch, cw) / 3.0, s_lo + 1.0)
         for _ in range(self._n_blobs):
             cy, cx = rng.rand(2) * [ch - 1, cw - 1]
             color = rng.rand(3) * 200.0 - 100.0
-            s = sigma * (0.8 + 0.6 * rng.rand())
+            # log-uniform sigma across the octaves; big blobs get muted
+            # amplitude (like natural 1/f spectra) so small structure
+            # stays visible on top of them
+            s = float(np.exp(rng.uniform(np.log(s_lo), np.log(s_hi))))
+            amp = (s_lo / s) ** 0.5
             blob = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * s * s))
-            img += blob[..., None] * color
+            img += blob[..., None] * color * amp
         return np.clip(img, 0.0, 255.0).astype(np.float32)
 
     def _batch(self, seeds, shift_bound: float | None = None) -> dict:

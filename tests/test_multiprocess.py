@@ -91,12 +91,15 @@ def _run_two_process(tmp_path):
 def test_two_process_dcn_path(tmp_path):
     rcs, outs = _run_two_process(tmp_path)
     if any(rcs) and any("Gloo context initialization failed" in o
-                        or "DEADLINE_EXCEEDED" in o for o in outs):
-        # gloo's rendezvous has a hard 30s deadline; on this single-core
+                        or "DEADLINE_EXCEEDED" in o
+                        or "BarrierError" in o
+                        or "CoordinationService" in o for o in outs):
+        # gloo's rendezvous has a hard 30s deadline, and the coordination
+        # service's shutdown barrier a similar one; on this single-core
         # host a contended scheduler (full suite + background jobs) can
-        # blow it transiently. Retry once — a deterministic failure fails
-        # both attempts. (A longer rendezvous timeout would be preferable,
-        # but jaxlib's make_gloo_tcp_collectives exposes only
+        # blow either transiently. Retry once — a deterministic failure
+        # fails both attempts. (A longer rendezvous timeout would be
+        # preferable, but jaxlib's make_gloo_tcp_collectives exposes only
         # hostname/interface — the 30s kv-store deadline is baked into the
         # C++ wrapper, checked jax 0.9: no Python-reachable knob.)
         rcs, outs = _run_two_process(tmp_path)

@@ -57,6 +57,14 @@ def main() -> None:
                     help="flownet_s thin-variant channel multiplier; the "
                          "CPU hedge runs 0.25 (~16x cheaper steps), the "
                          "TPU rungs keep the full reference widths")
+    ap.add_argument("--num-train", type=int, default=8192,
+                    help="unique procedural training samples. The dataset "
+                         "class default (64, sized for tests) lets the "
+                         "model MEMORIZE per-canvas flow constants instead "
+                         "of learning matching — train loss descends while "
+                         "held-out AEE stays at the zero-flow level "
+                         "(DESIGN.md r04). Generation is procedural, so "
+                         "large values cost nothing.")
     ap.add_argument("--curriculum-steps", type=int, default=0,
                     help="ramp the TRAIN max_shift from 1 px to --max-shift "
                          "over this many steps (0 = off). Diagnosis (r04, "
@@ -130,7 +138,8 @@ def main() -> None:
                           log_dir=os.path.dirname(args.out) or "."),
     )
     mesh = build_mesh(cfg.mesh)
-    ds = SyntheticData(cfg.data, feature_scale=args.feature_scale,
+    ds = SyntheticData(cfg.data, num_train=args.num_train,
+                       feature_scale=args.feature_scale,
                        max_shift=args.max_shift, style=args.style,
                        n_blobs=args.blobs)
 
@@ -168,15 +177,20 @@ def main() -> None:
     fp_keys = (
         "lr", "lr_decay_every", "feature_scale", "max_shift", "style",
         "blobs", "batch", "photometric", "smoothness_order", "occlusion",
-        "lambda_smooth", "width_mult", "curriculum_steps")
+        "lambda_smooth", "width_mult", "curriculum_steps", "num_train")
     fingerprint = {k: getattr(args, k) for k in fp_keys}
+    fingerprint["canvas_version"] = SyntheticData.CANVAS_VERSION
     # a lineage written before a knob existed has no key for it: the old
-    # run used that knob's DEFAULT, so compare missing keys against the
-    # argparse default — resuming is only valid when the current value
-    # matches it (e.g. adding --curriculum-steps to an old lineage must
-    # start fresh: the curriculum's whole point is easing lock-on from
-    # init)
+    # run used that knob's EFFECTIVE value at the time, so compare
+    # missing keys against that — resuming is only valid when the current
+    # value matches it (e.g. adding --curriculum-steps to an old lineage
+    # must start fresh: the curriculum's whole point is easing lock-on
+    # from init). For most knobs the historical value IS the argparse
+    # default; knobs whose argparse default intentionally moved (and the
+    # canvas generator version) carry explicit legacy values.
     fp_defaults = {k: ap.get_default(k) for k in fp_keys}
+    fp_defaults["num_train"] = 64   # pre-knob runs used the class default
+    fp_defaults["canvas_version"] = 1  # pre-r04 single-octave canvases
     fp_path = os.path.join(ckpt_dir, "config_fingerprint.json")
     if os.path.isdir(ckpt_dir):
         stale = args.fresh
@@ -243,6 +257,7 @@ def main() -> None:
             "blobs": args.blobs,
             "width_mult": args.width_mult,
             "curriculum_steps": args.curriculum_steps,
+            "num_train": args.num_train,
             "zero_flow_epe": round(zero_epe, 4),
             "loss": (f"{args.photometric}, canonical order="
                      f"{args.smoothness_order}, lambda="
