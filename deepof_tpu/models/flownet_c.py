@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..ops.corr import correlation
-from .common import ConvELU, FlowDecoder, flownet_tail
+from .common import ConvELU, FlowDecoder, flownet_tail, scaled_width
 from .flownet_s import FLOW_SCALES
 
 
@@ -25,6 +25,10 @@ class FlowNetC(nn.Module):
     max_disp: int = 20
     corr_stride: int = 2
     dtype: Any = jnp.float32
+    # Thin-variant channel multiplier (same role as FlowNetS.width_mult);
+    # the correlation volume's (2K+1)^2 displacement channels are
+    # architecture, not width, and never scale.
+    width_mult: float = 1.0
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
     max_downsample = 64  # conv1..conv6 stride-2 chain (same tail as FlowNet-S)
@@ -32,25 +36,27 @@ class FlowNetC(nn.Module):
     @nn.compact
     def __call__(self, pair: jnp.ndarray) -> list[jnp.ndarray]:
         dt = self.dtype
+        ch = lambda n: scaled_width(n, self.width_mult)  # noqa: E731
         img1, img2 = pair[..., :3], pair[..., 3:]
 
-        conv1 = ConvELU(64, (7, 7), 2, dtype=dt, name="conv1")
-        conv2 = ConvELU(128, (5, 5), 2, dtype=dt, name="conv2")
-        conv3 = ConvELU(256, (5, 5), 2, dtype=dt, name="conv3")
+        conv1 = ConvELU(ch(64), (7, 7), 2, dtype=dt, name="conv1")
+        conv2 = ConvELU(ch(128), (5, 5), 2, dtype=dt, name="conv2")
+        conv3 = ConvELU(ch(256), (5, 5), 2, dtype=dt, name="conv3")
         c1 = conv1(img1)
         c2 = conv2(c1)
         f1 = conv3(c2)
         f2 = conv3(conv2(conv1(img2)))  # siamese: same modules, shared weights
 
         corr = nn.elu(correlation(f1, f2, self.max_disp, self.corr_stride))
-        redir = ConvELU(32, (1, 1), dtype=dt, name="conv_redir")(f1)
+        redir = ConvELU(ch(32), (1, 1), dtype=dt, name="conv_redir")(f1)
         net = jnp.concatenate([corr, redir], axis=-1)
 
-        conv3_1 = ConvELU(256, dtype=dt, name="conv3_1")(net)
-        conv4_2, conv5_2, conv6_2 = flownet_tail(conv3_1, dt)
+        conv3_1 = ConvELU(ch(256), dtype=dt, name="conv3_1")(net)
+        conv4_2, conv5_2, conv6_2 = flownet_tail(conv3_1, dt,
+                                                 width_mult=self.width_mult)
 
         flows = FlowDecoder(
-            upconv_features=(512, 256, 128, 64, 32),
+            upconv_features=tuple(ch(f) for f in (512, 256, 128, 64, 32)),
             flow_channels=self.flow_channels,
             dtype=dt,
             name="decoder",

@@ -33,16 +33,18 @@ def build_model(name: str, flow_channels: int = 2, dtype: Any = jnp.float32,
         raise KeyError(f"unknown model {name!r}; available: {sorted(MODELS)}")
     cls = MODELS[name]
     if width_mult != 1.0:
-        # honored only by models that declare the field (flownet_s); the
-        # parity backbones keep exact reference widths — reject with a
-        # named error instead of a dataclass TypeError deep in __init__
+        # honored only by models that declare the field; the parity
+        # backbones keep exact reference widths — reject with a named
+        # error instead of a dataclass TypeError deep in __init__
         import dataclasses
 
         if "width_mult" not in {f.name for f in dataclasses.fields(cls)}:
+            supported = sorted(
+                n for n, c in MODELS.items()
+                if "width_mult" in {f.name for f in dataclasses.fields(c)})
             raise ValueError(
                 f"model {name!r} does not support width_mult "
-                f"(={width_mult}); only models with a width_mult field "
-                "(flownet_s) build thin variants")
+                f"(={width_mult}); thin variants exist for {supported}")
         kw["width_mult"] = width_mult
     if name == "ucf101_spatial":
         return cls(dtype=dtype, **kw)

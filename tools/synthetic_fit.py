@@ -57,6 +57,21 @@ def main() -> None:
                     help="flownet_s thin-variant channel multiplier; the "
                          "CPU hedge runs 0.25 (~16x cheaper steps), the "
                          "TPU rungs keep the full reference widths")
+    ap.add_argument("--model", default="flownet_s",
+                    choices=("flownet_s", "flownet_c"),
+                    help="flownet_c's explicit correlation cost volume "
+                         "builds matching into the architecture — the r04 "
+                         "supervised control showed FlowNet-S must DISCOVER "
+                         "correlation from scratch (the original needed "
+                         "~1M iterations), far beyond any in-round step "
+                         "budget, regardless of loss recipe (DESIGN.md)")
+    ap.add_argument("--max-disp", type=int, default=4,
+                    help="flownet_c correlation search radius in feature "
+                         "pixels x stride. The class default (20, sized "
+                         "for 320x448) would build 441 displacement maps "
+                         "on this tool's 8x8 conv3 grid with most offsets "
+                         "pure padding; 4 -> 25 maps covering +-32 image "
+                         "px, ample for --max-shift 4.")
     ap.add_argument("--num-train", type=int, default=8192,
                     help="unique procedural training samples. The dataset "
                          "class default (64, sized for tests) lets the "
@@ -117,7 +132,7 @@ def main() -> None:
     batch = args.batch
     cfg = ExperimentConfig(
         name="synthetic_fit",
-        model="flownet_s",
+        model=args.model,
         # the DEFAULT FlyingChairs loss config (`flyingChairsWrapFlow.py:
         # 43-49,120-123`): Charbonnier eps=1e-4 alpha_c=.25 alpha_s=.37,
         # lambda_smooth=1, weights 16/8/4/2/1/1 — unless an escalation
@@ -153,7 +168,9 @@ def main() -> None:
             return args.max_shift
         frac = min(s / args.curriculum_steps, 1.0)
         return min(1.0 + (args.max_shift - 1.0) * frac, args.max_shift)
-    model = build_model("flownet_s", width_mult=args.width_mult)
+    model_kw = ({"max_disp": args.max_disp} if args.model == "flownet_c"
+                else {})
+    model = build_model(args.model, width_mult=args.width_mult, **model_kw)
 
     def schedule(s):
         if not args.lr_decay_every:
@@ -175,6 +192,7 @@ def main() -> None:
 
     ckpt_dir = args.out + ".ckpt"
     fp_keys = (
+        "model", "max_disp",
         "lr", "lr_decay_every", "feature_scale", "max_shift", "style",
         "blobs", "batch", "photometric", "smoothness_order", "occlusion",
         "lambda_smooth", "width_mult", "curriculum_steps", "num_train")
