@@ -72,6 +72,12 @@ def main() -> None:
                          "on this tool's 8x8 conv3 grid with most offsets "
                          "pure padding; 4 -> 25 maps covering +-32 image "
                          "px, ample for --max-shift 4.")
+    ap.add_argument("--corr-stride", type=int, default=2,
+                    help="flownet_c correlation displacement stride in "
+                         "feature pixels; 1 gives the finest displacement "
+                         "bins (8 image px at the 1/8-res conv3 grid) — "
+                         "required for the cost volume to resolve shifts "
+                         "of ~1 feature pixel")
     ap.add_argument("--num-train", type=int, default=8192,
                     help="unique procedural training samples. The dataset "
                          "class default (64, sized for tests) lets the "
@@ -168,8 +174,8 @@ def main() -> None:
             return args.max_shift
         frac = min(s / args.curriculum_steps, 1.0)
         return min(1.0 + (args.max_shift - 1.0) * frac, args.max_shift)
-    model_kw = ({"max_disp": args.max_disp} if args.model == "flownet_c"
-                else {})
+    model_kw = ({"max_disp": args.max_disp, "corr_stride": args.corr_stride}
+                if args.model == "flownet_c" else {})
     model = build_model(args.model, width_mult=args.width_mult, **model_kw)
 
     def schedule(s):
@@ -192,7 +198,7 @@ def main() -> None:
 
     ckpt_dir = args.out + ".ckpt"
     fp_keys = (
-        "model", "max_disp",
+        "model", "max_disp", "corr_stride",
         "lr", "lr_decay_every", "feature_scale", "max_shift", "style",
         "blobs", "batch", "photometric", "smoothness_order", "occlusion",
         "lambda_smooth", "width_mult", "curriculum_steps", "num_train")

@@ -43,14 +43,15 @@ while [ $i -lt 60 ]; do
     sleep 120
 done
 
-# Escalation ladder (VERDICT r03 item 3): dense canvas first (the
-# sparse default provably stalls in an aperture basin at ~3.9 px —
-# 12k-step CPU run, artifacts/synthetic_fit_long.jsonl; the r04 CPU
-# rungs show the dense canvas alone does NOT fix it, and neither does
-# census — the diagnosed blocker is shifts beyond the finest levels'
-# photometric basin, DESIGN.md r04). Rung 2 is therefore the
-# diagnosis-driven shift curriculum; later rungs ADD one built quality
-# lever cumulatively so the artifacts record which lever mattered.
+# Fit ladder, reordered by the r04 CPU findings (DESIGN.md): rung 1 is
+# the configuration that MEASURABLY learns — FlowNet-C with the task's
+# displacement scale matched to the cost volume's bins (max_shift 8 px
+# at 64 px = ~1 feature px at the 1/8-res corr grid, stride 1). The
+# CPU run crossed half the zero-flow baseline within 500 steps. Later
+# rungs document the contrast: FlowNet-S (must discover correlation
+# from scratch — the r04 supervised control shows it cannot within any
+# in-round budget) with the curriculum and census levers, at full
+# width/30k TPU steps where the extra budget might still move it.
 FIT_ARGS_COMMON="--devices 0 --steps 30000 --eval-every 250 \
     --lr-decay-every 4000 --batch 16 --blobs 40"
 i=0
@@ -58,12 +59,12 @@ rung=1
 while [ $i -lt 20 ]; do
     i=$((i + 1))
     case $rung in
-        1) extra=""; tag=default ;;
-        2) extra="--curriculum-steps 8000"; tag=curriculum ;;
-        3) extra="--curriculum-steps 8000 --photometric census"
+        1) extra="--model flownet_c --max-disp 3 --corr-stride 1 --max-shift 8"
+           tag=corr8 ;;
+        2) extra=""; tag=default ;;
+        3) extra="--curriculum-steps 8000"; tag=curriculum ;;
+        *) extra="--curriculum-steps 8000 --photometric census"
            tag=curr_census ;;
-        *) extra="--curriculum-steps 8000 --photometric census --occlusion"
-           tag=curr_census_occ ;;
     esac
     echo "$(stamp) synthetic_fit TPU attempt $i rung=$tag" >> "$FLOG"
     # probe first in a throwaway subprocess; the fit itself has no wait loop
