@@ -4,11 +4,14 @@ Real FlyingChairs/Sintel data cannot be staged in this zero-egress
 container (DESIGN.md "Learning evidence"), so the quality proxy is the
 procedural dataset with exact ground truth (`data/datasets.py
 SyntheticData`): uniform-shift pairs, where the unsupervised objective's
-minimizer IS the true flow. This script trains FlowNet-S with the
-DEFAULT FlyingChairs loss configuration (Charbonnier, canonical
-smoothness, lambda=1, weights 16/8/4/2/1/1) and the FlyingChairs eval
-protocol (pr1 x 2, resize to GT resolution, AEE vs exact GT), recording
-EPE-vs-steps to artifacts/synthetic_fit.jsonl until EPE < 1 px.
+minimizer IS the true flow. The tool trains a flow model (--model:
+flownet_s, or flownet_c whose correlation cost volume makes matching
+learnable within small step budgets — DESIGN.md r04) with the DEFAULT
+FlyingChairs loss configuration (Charbonnier, canonical smoothness,
+lambda=1, weights 16/8/4/2/1/1; escalation levers opt-in) and the
+FlyingChairs eval protocol (pr1 x 2, resize to GT resolution, AEE vs
+exact GT), recording EPE-vs-steps to the --out jsonl until EPE < 1 px.
+Checkpointed + auto-resuming; config-fingerprinted per lineage.
 
 Run: python tools/synthetic_fit.py [--steps N] [--out PATH]
 (CPU: defaults to a 1-device mesh — this container has a single core, so
