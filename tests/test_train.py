@@ -347,6 +347,46 @@ def test_trainer_fit_steps_per_call(tmp_path):
     assert trainer.ckpt.latest_step() is not None
 
 
+def test_flownet_c_learns_matching_below_zero_flow(tmp_path):
+    """The r04 learning-evidence property, pinned: FlowNet-C with the
+    task displacement scale matched to its correlation bins (max_shift
+    8 px at 64 px = ~1 feature px at the 1/8-res corr grid, stride 1)
+    descends WELL below the zero-flow AEE under the default unsupervised
+    recipe within a few hundred steps — where FlowNet-S (which must
+    discover correspondence from scratch) provably parks at the
+    zero-flow level for any in-round budget (DESIGN.md r04; full run:
+    artifacts/synthetic_fit_cpu_corr8.jsonl, 0.99 px at step 6500)."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    cfg = cfg.replace(
+        model="flownet_c",
+        train=dataclasses.replace(cfg.train, eval_amplifier=2.0,
+                                  eval_clip=(-300.0, 250.0)))
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data, num_train=512, max_shift=8.0,
+                       style="blobs", n_blobs=40)
+    model = build_model("flownet_c", width_mult=0.25, max_disp=3,
+                        corr_stride=1)
+    tx = make_optimizer(cfg.optim, lambda s: 3e-4)
+    state = create_train_state(model, jnp.zeros((8, H, W, 6)), tx, seed=0)
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+
+    vflows = np.concatenate([ds.sample_val(8, i)["flow"] for i in range(2)])
+    zero_epe = float(np.sqrt((vflows ** 2).sum(-1)).mean())
+    rng = np.random.RandomState(0)
+    for _ in range(600):
+        b = jax.device_put(ds.sample_train(8, rng=rng), batch_sharding(mesh))
+        state, _ = step(state, b)
+    res = evaluate_aee(eval_fn, state.params, ds, cfg)
+    # the full-run curve's knee is between steps 250 and 500 (at batch
+    # 16): baseline-level until ~250, 0.55x by 500. 600 steps at batch 8
+    # sits past the knee; 0.85x still asserts genuine matching (a
+    # zero-flow collapse sits at 1.0x) with slack for the smaller batch
+    assert res["aee"] < 0.85 * zero_epe, (res["aee"], zero_epe)
+
+
 def test_volume_train_step(tmp_path):
     cfg = _cfg(tmp_path, time_step=3)
     mesh = build_mesh(cfg.mesh)
