@@ -355,7 +355,9 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     )
     mesh = build_mesh(cfg.mesh)
     model = build_model(cfg.model, flow_channels=2 * (time_step - 1),
-                        dtype=jnp.bfloat16)
+                        dtype=jnp.bfloat16,
+                        corr_max_disp=cfg.corr_max_disp,
+                        corr_stride=cfg.corr_stride)
     tx = make_optimizer(cfg.optim, lambda s: cfg.optim.learning_rate)
     state = create_train_state(
         model, jnp.zeros((batch, h, w, 3 * time_step)), tx, seed=0)

@@ -195,12 +195,26 @@ class TrainConfig:
 @dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "flyingchairs_flownet_s"
-    model: str = "flownet_s"  # flownet_s|vgg16|inception_v3|flownet_c|st_single|st_baseline
-    # Thin-variant channel multiplier — currently honored by flownet_s
-    # only (the parity backbones keep their exact reference widths).
-    # 1.0 = reference widths; the test suite uses 0.25 so full-train-step
-    # wiring checks don't pay 38M-param compute on the CPU mesh.
+    # any models/registry.py name: flownet_s | vgg16 | inception_v3 |
+    # flownet_c | flownet_cs | st_single | st_baseline | ucf101_spatial
+    model: str = "flownet_s"
+    # Thin-variant channel multiplier — honored by models declaring a
+    # width_mult field (flownet_s, flownet_c; the parity backbones keep
+    # their exact reference widths and build_model rejects non-default
+    # values for them by name). 1.0 = reference widths; the test suite
+    # uses 0.25 so full-train-step wiring checks don't pay 38M-param
+    # compute on the CPU mesh.
     width_mult: float = 1.0
+    # FlowNet-C/CS correlation cost-volume geometry. The displacement
+    # bins live on the 1/8-resolution conv3 grid: bin granularity =
+    # 8 * corr_stride image pixels, search radius ~ 8 * max_disp image
+    # pixels. Size them to the expected flow at that grid — a task whose
+    # displacements fit inside ONE bin is architecturally invisible to
+    # the correlation (DESIGN.md r04: for 8 px flows at 64 px images the
+    # working setting was corr_stride=1, corr_max_disp=3; the defaults
+    # match the FlowNet paper's 320x448 large-displacement regime).
+    corr_max_disp: int = 20
+    corr_stride: int = 2
     loss: LossConfig = field(default_factory=LossConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     data: DataConfig = field(default_factory=DataConfig)

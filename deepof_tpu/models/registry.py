@@ -27,25 +27,40 @@ MODELS = {
 }
 
 
+#: (config-surface name, model-field name, model-family default): knobs
+#: honored only by models that declare the field. Non-default values for
+#: a model without the field raise a NAMED error instead of silently
+#: dropping (the "displacements invisible to the correlation" class of
+#: silent failure, DESIGN.md r04) or a dataclass TypeError.
+_OPTIONAL_KNOBS = (
+    ("width_mult", "width_mult", 1.0),
+    ("corr_max_disp", "max_disp", 20),
+    ("corr_stride", "corr_stride", 2),
+)
+
+
 def build_model(name: str, flow_channels: int = 2, dtype: Any = jnp.float32,
-                width_mult: float = 1.0, **kw):
+                width_mult: float = 1.0, corr_max_disp: int = 20,
+                corr_stride: int = 2, **kw):
     if name not in MODELS:
         raise KeyError(f"unknown model {name!r}; available: {sorted(MODELS)}")
     cls = MODELS[name]
-    if width_mult != 1.0:
-        # honored only by models that declare the field; the parity
-        # backbones keep exact reference widths — reject with a named
-        # error instead of a dataclass TypeError deep in __init__
-        import dataclasses
+    import dataclasses
 
-        if "width_mult" not in {f.name for f in dataclasses.fields(cls)}:
+    fields = {f.name for f in dataclasses.fields(cls)}
+    passed = {"width_mult": width_mult, "corr_max_disp": corr_max_disp,
+              "corr_stride": corr_stride}
+    for knob, field, default in _OPTIONAL_KNOBS:
+        value = passed[knob]
+        if field in fields and field not in kw:
+            kw[field] = value
+        elif value != default and field not in fields:
             supported = sorted(
                 n for n, c in MODELS.items()
-                if "width_mult" in {f.name for f in dataclasses.fields(c)})
+                if field in {f.name for f in dataclasses.fields(c)})
             raise ValueError(
-                f"model {name!r} does not support width_mult "
-                f"(={width_mult}); thin variants exist for {supported}")
-        kw["width_mult"] = width_mult
+                f"model {name!r} does not support {knob} (={value}); "
+                f"models honoring it: {supported}")
     if name == "ucf101_spatial":
         return cls(dtype=dtype, **kw)
     return cls(flow_channels=flow_channels, dtype=dtype, **kw)

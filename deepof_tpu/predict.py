@@ -34,7 +34,9 @@ def restore_params(cfg: ExperimentConfig):
 
     t = cfg.data.time_step
     model = build_model(cfg.model, flow_channels=2 * (t - 1),
-                        width_mult=cfg.width_mult)
+                        width_mult=cfg.width_mult,
+                        corr_max_disp=cfg.corr_max_disp,
+                        corr_stride=cfg.corr_stride)
     h, w = cfg.data.image_size  # eval-protocol resolution (val is uncropped)
     tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
     template = create_train_state(

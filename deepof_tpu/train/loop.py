@@ -95,7 +95,9 @@ class Trainer:
         dtype = (jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16"
                  else jnp.float32)
         self.model = build_model(cfg.model, flow_channels=flow_channels,
-                                 dtype=dtype, width_mult=cfg.width_mult)
+                                 dtype=dtype, width_mult=cfg.width_mult,
+                                 corr_max_disp=cfg.corr_max_disp,
+                                 corr_stride=cfg.corr_stride)
 
         self.logger = MetricsLogger(cfg.train.log_dir)
         self.profiler = ProfilerSession(cfg.train.log_dir, enabled=profile)
