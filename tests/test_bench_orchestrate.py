@@ -196,6 +196,39 @@ def test_exhaustion_falls_back_to_last_good(monkeypatch, capsys, tmp_path):
     assert "error" in lines[0]  # the outage story still travels
 
 
+def test_bench_spc_math_and_last_good_gate(monkeypatch, tmp_path):
+    """bench() with steps_per_call=K: throughput normalizes per optimizer
+    step (per_call / K), lowered FLOPs are NOT divided by K (XLA counts a
+    scan body once), and a non-TPU backend never writes the last-known-
+    good fallback record."""
+    import os
+
+    import numpy as np
+
+    bench._import_compute()  # conftest forced the cpu backend already
+    monkeypatch.setattr(bench, "LAST_GOOD", str(tmp_path / "lg.json"))
+    monkeypatch.setattr(bench, "_init_devices", lambda timeout_s=240.0: [0])
+    monkeypatch.setattr(bench, "calibrate",
+                        lambda: {"matmul_tflops": 100.0, "rtt_ms": 1.0})
+    fake_cfg = types.SimpleNamespace(loss=types.SimpleNamespace(
+        warp_impl="auto"))
+    monkeypatch.setattr(
+        bench, "headline_setup",
+        lambda *a, **k: (fake_cfg, None, None, None, "state", "step", "b"))
+    monkeypatch.setattr(
+        bench, "time_train_step",
+        lambda step, state, b, steps, windows, warmup: (0.4, state,
+                                                        np.array([1.0])))
+    monkeypatch.setattr(bench, "step_flops", lambda *a: 8e9)
+    monkeypatch.setenv("BENCH_SPC", "4")
+    res = bench.bench()
+    assert res["steps_per_call"] == 4
+    assert abs(res["steps_per_sec"] - 10.0) < 1e-9   # 4 steps / 0.4 s call
+    assert abs(res["pairs_per_sec"] - 160.0) < 1e-9  # batch 16 x 10
+    assert res["flops_per_step"] == 8e9              # scan body counted once
+    assert not os.path.exists(tmp_path / "lg.json")  # cpu backend: no save
+
+
 def test_exhaustion_ignores_empty_or_zero_last_good(monkeypatch, capsys,
                                                     tmp_path):
     def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
