@@ -1,0 +1,126 @@
+"""Visual artifacts from a synthetic_fit checkpoint (the reference dumps
+flow-color/warp images during eval — `flyingChairsTrain.py:272-291`; this
+is the equivalent for the learning-evidence runs).
+
+For N held-out samples, writes side-by-side panels to --out:
+source | target | GT flow color | predicted flow color | warped recon.
+
+Run after a fit whose checkpoint survived (budget-exhausted lineages):
+    python tools/fit_viz.py --ckpt artifacts/synthetic_fit_cpu_viz.jsonl \
+        --out artifacts/viz_r04
+(--ckpt takes the fit's --out path; the tool derives <out>.ckpt and reads
+the lineage's config fingerprint so the model/data are rebuilt exactly.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", required=True,
+                    help="the fit's --out jsonl path (ckpt dir is derived)")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--samples", type=int, default=4)
+    ap.add_argument("--devices", type=int, default=1)
+    args = ap.parse_args()
+
+    if args.devices > 0:
+        force_cpu_devices(args.devices)
+    import cv2
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepof_tpu.core.config import (
+        DataConfig, ExperimentConfig, LossConfig, OptimConfig, TrainConfig)
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.ops.warp import backward_warp
+    from deepof_tpu.parallel.mesh import batch_sharding, build_mesh
+    from deepof_tpu.train.checkpoint import CheckpointManager
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+    from deepof_tpu.train.evaluate import postprocess_flow
+    from deepof_tpu.train.step import make_eval_fn
+    from deepof_tpu.utils.flowviz import flow_to_color
+
+    ckpt_dir = args.ckpt + ".ckpt"
+    if not os.path.isdir(ckpt_dir):
+        raise SystemExit(
+            f"no checkpoint under {ckpt_dir} (a fit that reached its "
+            "target removes its lineage; rerun with a smaller --steps so "
+            "the budget-exhausted path keeps one)")
+    with open(os.path.join(ckpt_dir, "config_fingerprint.json")) as f:
+        fp = json.load(f)
+
+    h = w = 64  # the fit tool's fixed resolution
+    cfg = ExperimentConfig(
+        name="fit_viz", model=fp.get("model", "flownet_s"),
+        width_mult=fp.get("width_mult", 1.0),
+        corr_max_disp=fp.get("max_disp", 20),
+        corr_stride=fp.get("corr_stride", 2),
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=fp["lr"]),
+        data=DataConfig(dataset="synthetic", image_size=(h, w),
+                        gt_size=(h, w), batch_size=8),
+        train=TrainConfig(seed=0, eval_amplifier=2.0, eval_clip=(-300, 250),
+                          eval_batch_size=8, log_dir=args.out),
+    )
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data, num_train=fp.get("num_train", 64),
+                       feature_scale=fp.get("feature_scale", 8),
+                       max_shift=fp.get("max_shift", 4.0),
+                       style=fp.get("style", "blobs"),
+                       n_blobs=fp.get("blobs", 8))
+    # corr knobs only for the corr family (synthetic_fit writes max_disp
+    # into every fingerprint, including flownet_s lineages)
+    corr_kw = ({"corr_max_disp": cfg.corr_max_disp,
+                "corr_stride": cfg.corr_stride}
+               if cfg.model == "flownet_c" else {})
+    model = build_model(cfg.model, width_mult=cfg.width_mult, **corr_kw)
+    tx = make_optimizer(cfg.optim, lambda s: fp["lr"])
+    state = create_train_state(model, jnp.zeros((8, h, w, 6)), tx, seed=0)
+    state = CheckpointManager(ckpt_dir, async_save=False).restore(state)
+    if state is None:
+        raise SystemExit(f"no checkpoint under {ckpt_dir}")
+    print("restored step", int(state.step))
+
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+    b = ds.sample_val(8, 0)
+    out = eval_fn(state.params, jax.device_put(b, batch_sharding(mesh)))
+    flow_half = np.asarray(out["flow"])  # finest flow x scale, half res
+    # the exact eval protocol: amplify -> clip(eval_clip) -> resize to GT
+    pred_full = postprocess_flow(flow_half, cfg, (h, w))
+
+    os.makedirs(args.out, exist_ok=True)
+    for i in range(min(args.samples, 8)):
+        src = np.asarray(b["source"][i])
+        tgt = np.asarray(b["target"][i])
+        gt = np.asarray(b["flow"][i])
+        pred = pred_full[i]
+        recon = np.asarray(backward_warp(
+            jnp.asarray(tgt)[None], jnp.asarray(pred)[None]))[0]
+        # shared normalization so GT and prediction colors are comparable
+        rad = max(float(np.hypot(gt[..., 0], gt[..., 1]).max()), 1e-3)
+        panel = np.concatenate([
+            src, tgt,
+            flow_to_color(gt, max_flow=rad),
+            flow_to_color(pred, max_flow=rad),
+            recon,
+        ], axis=1)
+        path = os.path.join(args.out, f"val{i}_src-tgt-gtflow-pred-warp.png")
+        cv2.imwrite(path, np.clip(panel, 0, 255).astype(np.uint8))
+        epe = float(np.hypot(*(pred - gt).transpose(2, 0, 1)).mean())
+        print(f"{path}  EPE {epe:.3f}")
+
+
+if __name__ == "__main__":
+    main()
