@@ -12,21 +12,36 @@
 # and main-process init aborts that attempt; only that process is lost).
 # All output lands under artifacts/ with timestamps.
 cd "$(dirname "$0")/.." || exit 1
-PLOG=artifacts/perf_probe_r04.log
-FLOG=artifacts/synthetic_fit_tpu_run_r04.log
+PLOG=artifacts/perf_probe_r05.log
+FLOG=artifacts/synthetic_fit_tpu_run_r05.log
 
 stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
 # Single-instance guard: two chains would race the same artifact paths
-# (the fit stage rm's and rewrites per-rung jsonl + ckpt lineages) and
-# double-book the one TPU chip. Stale pidfiles (SIGKILL'd chain) are
-# reclaimed by the liveness check.
-LOCK=artifacts/.tpu_chain.pid
-if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null; then
-    echo "$(stamp) another chain (pid $(cat "$LOCK")) is running; exiting" >> "$PLOG"
-    exit 0
+# (the fit stage rewrites per-rung jsonl + ckpt lineages) and
+# double-book the one TPU chip. mkdir is the atomic primitive (the old
+# check-then-write pidfile raced two simultaneous starts and a dead
+# chain's pidfile could block forever via PID reuse — ADVICE r04); the
+# pid inside lets a stale lock from a SIGKILL'd chain be reclaimed, and
+# the EXIT trap removes the lock on every normal/signalled exit.
+LOCK=artifacts/.tpu_chain.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+    holder=$(cat "$LOCK/pid" 2>/dev/null)
+    if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
+        echo "$(stamp) another chain (pid $holder) is running; exiting" >> "$PLOG"
+        exit 0
+    fi
+    # stale lock: holder is dead. Reclaim (rmdir+mkdir is not atomic,
+    # but both racers got here via a dead holder — worst case one loses
+    # the mkdir and exits via the liveness check next line).
+    rm -rf "$LOCK"
+    if ! mkdir "$LOCK" 2>/dev/null; then
+        echo "$(stamp) lost stale-lock race; exiting" >> "$PLOG"
+        exit 0
+    fi
 fi
-echo $$ > "$LOCK"
+echo $$ > "$LOCK/pid"
+trap 'rm -rf "$LOCK"' EXIT INT TERM
 
 echo "$(stamp) chain start" >> "$PLOG"
 i=0
@@ -73,9 +88,11 @@ while [ $i -lt 20 ]; do
         sleep 300
         continue
     fi
-    # stale per-tag output from an earlier session/attempt must not feed
-    # the escalation grep below if this run dies before truncating it
-    rm -f "artifacts/synthetic_fit_tpu_$tag.jsonl"
+    # Do NOT delete stale per-tag output: synthetic_fit reads it for
+    # prior_best bookkeeping and appends on resume, so the jsonl + ckpt
+    # lineage must survive across attempts (ADVICE r04 — the old rm -f
+    # orphaned the ckpt's history). Staleness is handled below by
+    # gating escalation on the FINAL record of the file only.
     timeout 3600 python tools/synthetic_fit.py $FIT_ARGS_COMMON $extra \
         --out "artifacts/synthetic_fit_tpu_$tag.jsonl" >> "$FLOG" 2>&1
     rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
@@ -89,9 +106,12 @@ while [ $i -lt 20 ]; do
     # A "budget exhausted" outcome means the rung genuinely ran out of
     # steps short of 1 px: escalate. Anything else (tunnel drop mid-run
     # writes an "interrupted" outcome; timeout/wedge writes none): retry
-    # the same rung.
-    if grep -q 'budget exhausted' "artifacts/synthetic_fit_tpu_$tag.jsonl" \
-        2>/dev/null && [ "$rc" -eq 1 ] && [ "$rung" -lt 4 ]; then
+    # the same rung. Only the LAST record counts — an earlier session's
+    # exhausted outcome deeper in the lineage must not trigger
+    # escalation for an attempt that died mid-run (ADVICE r04).
+    if tail -1 "artifacts/synthetic_fit_tpu_$tag.jsonl" 2>/dev/null \
+        | grep -q 'budget exhausted' \
+        && [ "$rc" -eq 1 ] && [ "$rung" -lt 4 ]; then
         rung=$((rung + 1))
     fi
     sleep 120
