@@ -255,6 +255,12 @@ def loss_interp(
     diff_x, diff_y, mx, my = _smoothness_diffs(cfg, h, w)
 
     if cfg.smoothness == "canonical":
+        if cfg.edge_aware:
+            raise ValueError(
+                "loss.edge_aware pairs only with smoothness='depthwise' "
+                "(the gen-1 variant it comes from, `version1/model/"
+                "warpflow.py:93-157`); the canonical branch would silently "
+                "skip the Sobel weighting")
         # x-diff of U masked at last col, y-diff of V masked at last row;
         # optional border mask pre-Charbonnier (UCF variant).
         du = diff_x(sflow[..., 0:1]) * mx
@@ -318,15 +324,41 @@ def loss_interp_multi(
 
     flows: (B, h, w, 2*(T-1)) raw head output; volume: (B, h, w, 3*T)
     LRN-normalized channel-stacked frames. Each consecutive pair (t, t+1) is
-    warped with its own flow pair; Charbonnier over all 3*(T-1) reconstructed
-    channels; smoothness per pair with both smoothness and border masks
-    applied pre-Charbonnier; U from even flow channels, V from odd.
+    warped with its own flow pair; photometric penalty over all T-1
+    reconstructed frames (Charbonnier elementwise, or per-pair census —
+    the frames fold into the batch axis for the descriptor transform);
+    smoothness per pair with both smoothness and border masks applied
+    pre-Charbonnier; U from even flow channels, V from odd.
+
+    Knobs the volume path cannot honor raise by NAME here (the silent-drop
+    failure class, VERDICT r04 weak #4): `edge_aware_photo` / `edge_aware`
+    exist only in the reference's 2-frame gen-1/vgg variants, `occlusion`
+    needs backward flows no volume head produces (also rejected at
+    `train/step.py::make_train_step`), and the volume smoothness shape is
+    the reference's own per-pair form (`sintelWrapFlow.py:565-600`), not
+    the depthwise variant.
     """
     if cfg.edge_aware_photo:
         raise ValueError(
             "loss.edge_aware_photo is two-frame only (the reference's "
             "needImageGradients exists only in the vgg 2-frame variant); "
             "the multi-frame volume loss would silently skip it")
+    if cfg.edge_aware:
+        raise ValueError(
+            "loss.edge_aware is two-frame depthwise only "
+            "(`version1/model/warpflow.py:93-157`); the multi-frame volume "
+            "loss would silently skip the Sobel smoothness weighting")
+    if cfg.occlusion:
+        raise ValueError(
+            "loss.occlusion=true is unsupported by the multi-frame volume "
+            "loss (no backward flows per pair); the masking would be "
+            "silently skipped")
+    if cfg.smoothness != "canonical":
+        raise ValueError(
+            f"loss.smoothness={cfg.smoothness!r} is unsupported by the "
+            "multi-frame volume loss, whose per-pair smoothness shape is "
+            "fixed by the reference (`sintelWrapFlow.py:565-600`); use "
+            "'canonical'")
     b, h, w, c3t = volume.shape
     t = c3t // 3
     scaled = flows * flow_scale
@@ -334,12 +366,35 @@ def loss_interp_multi(
                                  impl=cfg.warp_impl).astype(volume.dtype)
 
     bmask = border_mask(h, w, cfg.border_ratio)
-    diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])
-    ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
     n_interior = jnp.sum(bmask)
-    level_on = (n_interior > 0).astype(ele.dtype)
+    level_on = (n_interior > 0).astype(recon.dtype)
     num_valid = jnp.maximum(b * 3 * (t - 1) * n_interior, 1.0)
-    photo = jnp.sum(ele) / num_valid
+    if cfg.photometric == "census":
+        from ..ops.census import census_distance, census_transform
+
+        # Per-pair census: the descriptor is per-image (grayscale over a
+        # 3-channel frame), so fold the T-1 reconstructed frames into the
+        # batch axis and compare each against its source frame. Same
+        # widened border mask as the 2-frame census branch.
+        cmask = border_mask(h, w, cfg.border_ratio,
+                            min_width=cfg.census_window // 2)[None, :, :, None]
+        rec_f = jnp.moveaxis(
+            recon.reshape(b, h, w, t - 1, 3), 3, 1
+        ).reshape(b * (t - 1), h, w, 3)
+        src_f = jnp.moveaxis(
+            volume[..., : 3 * (t - 1)].reshape(b, h, w, t - 1, 3), 3, 1
+        ).reshape(b * (t - 1), h, w, 3)
+        dist = census_distance(
+            census_transform(rec_f, cfg.census_window),
+            census_transform(src_f, cfg.census_window))
+        vis = jnp.broadcast_to(cmask, dist.shape)
+        photo = jnp.sum(dist * vis) / jnp.maximum(jnp.sum(vis), 1.0)
+    elif cfg.photometric == "charbonnier":
+        diff = 255.0 * (recon - volume[..., : 3 * (t - 1)])
+        ele = charbonnier(diff, cfg.epsilon, cfg.alpha_c) * bmask[None, :, :, None]
+        photo = jnp.sum(ele) / num_valid
+    else:
+        raise ValueError(f"unknown photometric variant {cfg.photometric!r}")
 
     sflow = scaled if cfg.smooth_scaled_flow else flows
     diff_x, diff_y, mx, my = _smoothness_diffs(cfg, h, w)
