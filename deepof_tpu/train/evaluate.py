@@ -81,12 +81,19 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
     Every val sample is counted exactly once for any eval_batch_size
     (matching the reference's full-split iteration,
     `flyingChairsTrain.py:227-236`): batches are ceil-divided and the
-    final one — padded by `sample_val`'s wrap to the head of the split —
-    is sliced to its unseen rows before metrics. The eval_fn still runs
-    at the full batch shape, so no extra jit compile. `val_loss` is the
-    one remainder-affected diagnostic: the jitted total is a scalar mean
-    over the padded batch, so duplicated rows are weighted into it
-    (metric-protocol fields are exact)."""
+    final, short one is evaluated by tiling its `v` unseen rows
+    cyclically across L = v/gcd(v, bs) full-shape calls — every row
+    appears exactly bs/gcd times, so the mean of the L jitted
+    batch-mean totals IS the uniform mean over the v rows, making
+    `val_loss` exact for any eval_batch_size (VERDICT r04 item 7)
+    whenever the loss is row-separable (all variants except
+    `loss.occlusion`, whose visibility normalizer couples rows — there
+    a split-wide val_loss from batch means is composition-dependent by
+    definition). The eval_fn only ever sees the full batch shape: no
+    extra jit compile, and the sharded path never receives a batch dim
+    the mesh can't divide."""
+    import math as _math
+
     bs = cfg.train.eval_batch_size
     n_val = max(dataset.num_val, 1)
     n_batches = -(-n_val // bs)  # ceil: cover the remainder batch too
@@ -98,7 +105,25 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
     for bid in range(n_batches):
         batch = dataset.sample_val(bs, bid)
         valid = min(bs, n_val - bid * bs)
-        out = {k: np.asarray(v) for k, v in eval_fn(params, batch).items()}
+        if valid < bs:
+            # remainder: replace sample_val's wrap-to-head padding (rows
+            # from OTHER batches, which polluted val_loss) with the
+            # cyclic self-tiling described in the docstring
+            vrows = {k: np.asarray(v)[:valid] for k, v in batch.items()}
+            n_tiles = valid // _math.gcd(valid, bs)
+            tile_totals = []
+            out = None
+            for j in range(n_tiles):
+                idx = np.arange(j * bs, (j + 1) * bs) % valid
+                o = eval_fn(params, {k: v[idx] for k, v in vrows.items()})
+                o = {k: np.asarray(x) for k, x in o.items()}
+                if j == 0:
+                    out = o  # rows 0..valid-1 are the unseen rows in order
+                tile_totals.append(float(o["total"]))
+            batch_total = float(np.mean(tile_totals))
+        else:
+            out = {k: np.asarray(v) for k, v in eval_fn(params, batch).items()}
+            batch_total = float(out["total"])
         gt = batch["flow"][:valid]
         pred = postprocess_flow(out["flow"][:valid], cfg, gt.shape[1:3])
         # AEE per flow pair, averaged (multi-frame: all T-1 pairs, like
@@ -107,7 +132,7 @@ def evaluate_aee(eval_fn, params, dataset, cfg: ExperimentConfig,
         for p in range(0, gt.shape[-1], 2):
             epes.append((float(flow_epe(pred[..., p : p + 2], gt[..., p : p + 2])), valid))
             aaes.append((float(flow_aae(pred[..., p : p + 2], gt[..., p : p + 2])), valid))
-        totals.append((float(out["total"]), valid))
+        totals.append((batch_total, valid))
         pa, ga = np.abs(pred), np.abs(gt)
         p_sum += float(pa.sum()); p_n += pa.size; p_max = max(p_max, float(pa.max()))
         g_sum += float(ga.sum()); g_n += ga.size; g_max = max(g_max, float(ga.max()))

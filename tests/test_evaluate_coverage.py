@@ -65,3 +65,20 @@ def test_remainder_batch_weights_per_sample_not_per_batch():
     res = evaluate_aee(_eval_fn, None, _FakeVal(), _cfg(4))
     assert res["aee"] == pytest.approx(4.5, abs=1e-6)
     assert res["aee"] != pytest.approx(5.1667, abs=1e-3)
+
+
+def _rowmean_eval_fn(params, batch):
+    # total = batch-mean of a per-row quantity (the id), mimicking the
+    # row-separable jitted loss: exact split val_loss == mean(ids) == 4.5
+    return {"total": np.float32(batch["flow"][..., 0].mean()),
+            "flow": np.zeros_like(batch["flow"])}
+
+
+@pytest.mark.parametrize("bs", [3, 4, 7, 8, 16])
+def test_val_loss_exact_for_any_batch_size(bs):
+    """VERDICT r04 item 7: the remainder batch's val_loss contribution
+    must weight only unseen rows. The cyclic self-tiling makes the
+    split val_loss exactly mean(ids) for every batch size (previously
+    the wrap-padded head rows were averaged into the final batch)."""
+    res = evaluate_aee(_rowmean_eval_fn, None, _FakeVal(), _cfg(bs))
+    assert res["val_loss"] == pytest.approx(4.5, abs=1e-5)
