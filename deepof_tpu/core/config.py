@@ -123,6 +123,12 @@ class DataConfig:
     batch_size: int = 4
     time_step: int = 2  # frames per sample; Sintel volumes use 10
     sintel_pass: str = "final"  # clean | final
+    # Gen-1 Sintel pair-mode split (`version1/loader/sintelLoader.py:
+    # 38-70`): path to Sintel_train_val.txt — one line per consecutive
+    # frame pair over sorted clips x sorted frames ("1" = train,
+    # "2" = val). Requires time_step=2 (the gen-1 loader is pair-only);
+    # None keeps the gen-2 window-membership split.
+    sintel_pair_split_file: str | None = None
     # Host-side augmentation streams (reference `flyingChairsTrain_vgg.py:186-195`):
     # photometric-augmented pair feeds the network, geometric-only feeds the loss.
     augment_geo: bool = False

@@ -241,6 +241,11 @@ class SintelData:
     def __init__(self, cfg: DataConfig):
         self.cfg = cfg
         self.t = cfg.time_step
+        if cfg.sintel_pair_split_file is not None and self.t != 2:
+            raise ValueError(
+                "data.sintel_pair_split_file is the gen-1 PAIR split "
+                "(`version1/loader/sintelLoader.py:38-70`) and requires "
+                f"time_step=2; got time_step={self.t}")
         img_root = os.path.join(cfg.data_path, "training", cfg.sintel_pass)
         flow_root = os.path.join(cfg.data_path, "training", "flow")
         clips = sorted(os.listdir(img_root))
@@ -270,6 +275,24 @@ class SintelData:
                 val.append(clip_start)
             if clip == "bamboo_2" and n_windows > self.t:
                 val.append(clip_start + self.t)
+        if cfg.sintel_pair_split_file is not None:
+            # Gen-1 membership (`sintelLoader.py:47-70`): the k-th line of
+            # Sintel_train_val.txt labels the k-th consecutive frame pair
+            # in sorted clip x frame order — with time_step=2 that order
+            # IS self.windows' construction order. "1" = train, "2" = val.
+            with open(cfg.sintel_pair_split_file) as sf:
+                labels = [ln.strip()[:1] for ln in sf if ln.strip()]
+            if len(labels) != len(self.windows):
+                raise ValueError(
+                    f"pair split file {cfg.sintel_pair_split_file!r} has "
+                    f"{len(labels)} entries but the dataset has "
+                    f"{len(self.windows)} consecutive pairs")
+            bad = sorted({c for c in labels} - {"1", "2"})
+            if bad:
+                raise ValueError(
+                    f"pair split file {cfg.sintel_pair_split_file!r} has "
+                    f"entries {bad}; expected '1' (train) or '2' (val)")
+            val = [i for i, c in enumerate(labels) if c == "2"]
         self.val_idx = val
         self.train_idx = [i for i in range(len(self.windows)) if i not in set(self.val_idx)]
         self.num_train, self.num_val = len(self.train_idx), len(self.val_idx)

@@ -115,6 +115,39 @@ def test_sintel_crop(tmp_path):
     assert b["volume"].shape == (1, 16, 32, 6)
 
 
+def test_sintel_gen1_pair_split(tmp_path):
+    """Gen-1 Sintel_train_val.txt membership (`version1/loader/
+    sintelLoader.py:38-70`): line k labels the k-th consecutive pair in
+    sorted clip x frame order, '1' = train, '2' = val (VERDICT r04
+    item 6 — this split was unreachable by config)."""
+    import pytest
+
+    _make_sintel(tmp_path)  # 2 clips x 5 pairs = 10 pairs
+    split = tmp_path / "Sintel_train_val.txt"
+    labels = ["1", "2", "1", "1", "2", "1", "1", "1", "2", "1"]
+    split.write_text("\n".join(labels) + "\n")
+    cfg = DataConfig(dataset="sintel", data_path=str(tmp_path),
+                     image_size=(32, 64), gt_size=(32, 64), time_step=2,
+                     sintel_pass="final",
+                     sintel_pair_split_file=str(split))
+    ds = SintelData(cfg)
+    assert ds.val_idx == [1, 4, 8]
+    assert ds.num_train == 7 and ds.num_val == 3
+    # pair 1 = alley_1 frames 2-3; pair 8 = bamboo_2 frames 4-5
+    assert ds.windows[1][0].endswith("alley_1/frame_0002.png")
+    assert ds.windows[8][0].endswith("bamboo_2/frame_0004.png")
+
+    # volume-mode configs must reject the pair split by name
+    with pytest.raises(ValueError, match="time_step=2"):
+        SintelData(DataConfig(dataset="sintel", data_path=str(tmp_path),
+                              image_size=(32, 64), time_step=3,
+                              sintel_pair_split_file=str(split)))
+    # wrong entry count raises (guards silent misalignment)
+    split.write_text("1\n2\n")
+    with pytest.raises(ValueError, match="2 entries"):
+        SintelData(cfg)
+
+
 def test_ucf101_eval_at_reference_scale(tmp_path):
     """The accuracy aggregation path (`evaluate_ucf101`) at the reference's
     101-class scale (`ucf101train.py:210-223`): one batch per class, every
@@ -260,8 +293,12 @@ def test_synthetic_train_shift_override_keeps_canvas():
     ds = SyntheticData(cfg, max_shift=4.0, style="blobs", n_blobs=20)
     full = ds.sample_train(4, iteration=0)
     curr = ds.sample_train(4, iteration=0, max_shift=1.0)
-    assert float(np.abs(full["flow"]).max()) == 4.0 or \
-        float(np.abs(full["flow"]).max()) <= 4.0  # bound holds
+    full_max = float(np.abs(full["flow"]).max())
+    assert full_max <= 4.0  # bound holds
+    # and the full draw actually exceeds the curriculum bound, so the
+    # override comparison below is meaningful (ADVICE r04: the old
+    # `== 4.0 or <= 4.0` collapsed to the bound check alone)
+    assert full_max > 1.0
     assert float(np.abs(curr["flow"]).max()) <= 1.0
     np.testing.assert_array_equal(full["source"], curr["source"])
     val_a = ds.sample_val(4, 0)
