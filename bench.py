@@ -234,13 +234,28 @@ def _save_last_good(res: dict) -> None:
         pass
 
 
+#: Max age of a last-good record the fallback will serve (ADVICE r04: an
+#: unbounded fallback lets a consumer keying on exit status treat an
+#: arbitrarily old measurement as fresh). 48h covers "captured earlier
+#: this session or the previous one"; older chips/configs have drifted
+#: too far to stand in for today's tree.
+LAST_GOOD_MAX_AGE_S = 48 * 3600.0
+
+
 def _load_last_good() -> dict | None:
     try:
         with open(LAST_GOOD) as f:
             lg = json.load(f)
+        age = time.time() - time.mktime(
+            time.strptime(lg.get("measured_at", ""), "%Y-%m-%dT%H:%M:%SZ"))
+        # measured_at is UTC; mktime is local — this container runs UTC,
+        # and the bound is deliberately coarse (hours, not minutes)
+        if age > LAST_GOOD_MAX_AGE_S:
+            _plog(f"last_good too old ({age / 3600.0:.1f}h > 48h); ignoring")
+            return None
         if lg.get("res", {}).get("pairs_per_sec_per_chip", 0) > 0:
             return lg
-    except (OSError, ValueError):
+    except (OSError, ValueError, OverflowError):
         pass
     return None
 

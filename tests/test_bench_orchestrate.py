@@ -16,11 +16,21 @@ import bench
 
 
 class _Clock:
-    """Deterministic stand-in for bench.time (orchestrate only calls
-    time/sleep/strftime/gmtime)."""
+    """Deterministic stand-in for bench.time (orchestrate calls
+    time/sleep/strftime/gmtime; the last-good age bound also calls
+    mktime/strptime — those delegate to the real module so wall-clock
+    timestamps written by the tests compare sanely against self.t,
+    which starts at the real current time)."""
 
     def __init__(self):
-        self.t = 0.0
+        import time as _real_time
+
+        self._real = _real_time
+        self.t0 = _real_time.time()
+        self.t = self.t0
+
+    def elapsed(self):
+        return self.t - self.t0
 
     def time(self):
         return self.t
@@ -33,6 +43,12 @@ class _Clock:
 
     def gmtime(self):
         return None
+
+    def mktime(self, tm):
+        return self._real.mktime(tm)
+
+    def strptime(self, s, fmt):
+        return self._real.strptime(s, fmt)
 
 
 def _wire(monkeypatch, tmp_path, alive, run):
@@ -71,7 +87,7 @@ def test_relays_child_success_line_verbatim(monkeypatch, capsys, tmp_path):
     assert e.value.code == 0
     lines = _json_lines(capsys.readouterr().out)
     assert lines == [json.loads(good)]
-    assert clock.t < 1500
+    assert clock.elapsed() < 1500
 
 
 def test_retries_after_failed_child_until_success(monkeypatch, capsys,
@@ -178,9 +194,13 @@ def test_exhaustion_falls_back_to_last_good(monkeypatch, capsys, tmp_path):
     def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
         raise AssertionError("child must not run when tunnel is down")
 
+    import time as _time
+
     _wire(monkeypatch, tmp_path, lambda: False, run)
+    fresh = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                           _time.gmtime(_time.time() - 3600))
     (tmp_path / "last_good.json").write_text(json.dumps({
-        "measured_at": "2026-07-31T04:00:00Z",
+        "measured_at": fresh,
         "res": {"pairs_per_sec_per_chip": 241.7, "matmul_tflops": 63.4,
                 "rtt_ms": 67.0, "batch": 16, "warp_impl": "auto",
                 "mfu_nominal": 0.11, "mfu_vs_matmul": 0.33}}))
@@ -191,9 +211,29 @@ def test_exhaustion_falls_back_to_last_good(monkeypatch, capsys, tmp_path):
     assert len(lines) == 1
     assert lines[0]["value"] == 241.7
     assert lines[0]["stale"] is True
-    assert lines[0]["measured_at"] == "2026-07-31T04:00:00Z"
+    assert lines[0]["measured_at"] == fresh
     assert lines[0]["mfu_nominal"] == 0.11
     assert "error" in lines[0]  # the outage story still travels
+
+
+def test_exhaustion_skips_aged_out_last_good(monkeypatch, capsys, tmp_path):
+    """A last-good record older than LAST_GOOD_MAX_AGE_S must not be
+    served as a stale success (ADVICE r04: unbounded fallback age)."""
+    import time as _time
+
+    def run(cmd, timeout, capture_output, text, env):  # pragma: no cover
+        raise AssertionError("child must not run when tunnel is down")
+
+    _wire(monkeypatch, tmp_path, lambda: False, run)
+    old = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         _time.gmtime(_time.time() - 49 * 3600))
+    (tmp_path / "last_good.json").write_text(json.dumps({
+        "measured_at": old, "res": {"pairs_per_sec_per_chip": 241.7}}))
+    with pytest.raises(SystemExit) as e:
+        bench.orchestrate(deadline_s=700)
+    assert e.value.code == 1
+    lines = _json_lines(capsys.readouterr().out)
+    assert len(lines) == 1 and lines[0]["value"] == 0.0
 
 
 def test_bench_spc_math_and_last_good_gate(monkeypatch, tmp_path):
