@@ -264,15 +264,29 @@ def test_grad_accum_matches_large_batch(tmp_path):
     # programs whose f32 forward rounding differs, and the warp's
     # floor/clip indexing turns a rounding flip at an integer flow
     # boundary into a DISCRETE gradient jump at that pixel — observed as
-    # isolated ~1e-2-relative param diffs (one SGD lr=1e-2 step). The
-    # bound below absorbs that discontinuity amplification; a wiring bug
-    # (e.g. missed 1/K averaging) is an O(1) relative error and still
-    # fails loudly.
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(
-            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
-            rtol=5e-2, atol=5e-4),
-        state_sa.params, state_sb.params)
+    # isolated ~1e-2-relative param diffs (one SGD lr=1e-2 step). So the
+    # MAX bound absorbs the few discontinuity-amplified elements, while
+    # the 99.9th-percentile bound keeps the BULK of parameters tight
+    # (ADVICE r04: a blanket 5e-2 rtol would also pass a sub-5%
+    # systematic error like an off-by-one in the 1/K averaging; a
+    # systematic bug shifts every element and trips the percentile).
+    diffs, refs = [], []
+
+    def _collect(a, b):
+        diffs.append(np.abs(np.asarray(jax.device_get(a), np.float64)
+                            - np.asarray(jax.device_get(b), np.float64)).ravel())
+        refs.append(np.abs(np.asarray(jax.device_get(b), np.float64)).ravel())
+
+    jax.tree_util.tree_map(_collect, state_sa.params, state_sb.params)
+    d, r = np.concatenate(diffs), np.concatenate(refs)
+    # loose envelope (the old allclose bound): holds EVERYWHERE
+    loose = d > 5e-4 + 5e-2 * r
+    assert not loose.any(), \
+        f"{loose.sum()} elements beyond the warp-discontinuity envelope"
+    # tight envelope: only the isolated warp-discontinuity pixels may
+    # exceed it — a systematic error shifts every element and trips this
+    tight_frac = float(np.mean(d > 5e-4 + 1e-3 * r))
+    assert tight_frac < 1e-3, f"tight-envelope violations: {tight_frac:.2e}"
 
 
 def test_ckpt_every_steps(tmp_path):
