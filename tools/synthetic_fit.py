@@ -61,13 +61,23 @@ def main() -> None:
                          "CPU hedge runs 0.25 (~16x cheaper steps), the "
                          "TPU rungs keep the full reference widths")
     ap.add_argument("--model", default="flownet_s",
-                    choices=("flownet_s", "flownet_c"),
+                    choices=("flownet_s", "flownet_c", "inception_v3",
+                             "vgg16"),
                     help="flownet_c's explicit correlation cost volume "
                          "builds matching into the architecture — the r04 "
                          "supervised control showed FlowNet-S must DISCOVER "
                          "correlation from scratch (the original needed "
                          "~1M iterations), far beyond any in-round step "
-                         "budget, regardless of loss recipe (DESIGN.md)")
+                         "budget, regardless of loss recipe (DESIGN.md). "
+                         "The parity backbones (flownet_s, and the "
+                         "reference's actual training model inception_v3, "
+                         "`flyingChairsTrain.py:103`) learn in-budget only "
+                         "in the small-displacement regime (--max-shift "
+                         "<= ~2: photometric refinement inside the fine "
+                         "levels' basin, no correspondence discovery "
+                         "needed — the regime of the reference's UCF-101 "
+                         "video task). inception_v3/vgg16 ignore "
+                         "--width-mult (reference widths only).")
     ap.add_argument("--max-disp", type=int, default=4,
                     help="flownet_c correlation search radius in feature "
                          "pixels x stride. The class default (20, sized "
