@@ -99,6 +99,15 @@ def main() -> None:
                          "held-out AEE stays at the zero-flow level "
                          "(DESIGN.md r04). Generation is procedural, so "
                          "large values cost nothing.")
+    ap.add_argument("--curriculum-start", type=float, default=1.0,
+                    help="TRAIN displacement bound at step 0 of the "
+                         "curriculum ramp. Sub-pixel values (continuous "
+                         "styles only — blobs quantizes to whole pixels) "
+                         "put EVERY pixel's zero-flow init inside the "
+                         "warp's linear (Lucas-Kanade) regime, the "
+                         "coherent-gradient condition for a plain conv "
+                         "stack to lock onto input-dependence before the "
+                         "ramp grows the task")
     ap.add_argument("--curriculum-steps", type=int, default=0,
                     help="ramp the TRAIN max_shift from 1 px to --max-shift "
                          "over this many steps (0 = off). Diagnosis (r04, "
@@ -186,7 +195,8 @@ def main() -> None:
         if not args.curriculum_steps:
             return args.max_shift
         frac = min(s / args.curriculum_steps, 1.0)
-        return min(1.0 + (args.max_shift - 1.0) * frac, args.max_shift)
+        start = args.curriculum_start
+        return min(start + (args.max_shift - start) * frac, args.max_shift)
     model_kw = ({"max_disp": args.max_disp, "corr_stride": args.corr_stride}
                 if args.model == "flownet_c" else {})
     model = build_model(args.model, width_mult=args.width_mult, **model_kw)
@@ -214,7 +224,8 @@ def main() -> None:
         "model", "max_disp", "corr_stride",
         "lr", "lr_decay_every", "feature_scale", "max_shift", "style",
         "blobs", "batch", "photometric", "smoothness_order", "occlusion",
-        "lambda_smooth", "width_mult", "curriculum_steps", "num_train")
+        "lambda_smooth", "width_mult", "curriculum_steps",
+        "curriculum_start", "num_train")
     fingerprint = {k: getattr(args, k) for k in fp_keys}
     fingerprint["canvas_version"] = SyntheticData.CANVAS_VERSION
     # a lineage written before a knob existed has no key for it: the old
@@ -294,6 +305,7 @@ def main() -> None:
             "blobs": args.blobs,
             "width_mult": args.width_mult,
             "curriculum_steps": args.curriculum_steps,
+            "curriculum_start": args.curriculum_start,
             "num_train": args.num_train,
             "zero_flow_epe": round(zero_epe, 4),
             "loss": (f"{args.photometric}, canonical order="
