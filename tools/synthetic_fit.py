@@ -135,6 +135,15 @@ def main() -> None:
         "artifacts", "synthetic_fit.jsonl"))
     args = ap.parse_args()
 
+    # SIGTERM (the chain's `timeout`, the CPU guard's window kill) must
+    # run the finally-block outcome write just like SIGINT does — without
+    # this, a killed run leaves no terminal record (observed r05: the
+    # blobs-2px run's outcome had to be reconstructed by hand)
+    import signal
+
+    signal.signal(signal.SIGTERM,
+                  lambda *_: (_ for _ in ()).throw(SystemExit(143)))
+
     if args.devices > 0:
         force_cpu_devices(args.devices)
     import jax
