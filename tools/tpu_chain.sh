@@ -41,7 +41,11 @@ if ! mkdir "$LOCK" 2>/dev/null; then
     fi
 fi
 echo $$ > "$LOCK/pid"
-trap 'rm -rf "$LOCK"' EXIT INT TERM
+# INT/TERM must EXIT after cleanup — a bare cleanup trap swallows the
+# signal and the script keeps running lockless (observed r05: a TERM'd
+# chain survived and deleted its successor's lock)
+trap 'rm -rf "$LOCK"; trap - EXIT; exit 143' INT TERM
+trap 'rm -rf "$LOCK"' EXIT
 
 echo "$(stamp) chain start" >> "$PLOG"
 i=0
