@@ -1,0 +1,129 @@
+"""Input-dependence diagnostic for a synthetic_fit checkpoint lineage.
+
+The r04/r05 fitting studies needed a sharper signal than the AEE curve:
+a run parked at the zero-flow level can be (a) collapsed to constant
+near-zero output (no input-dependence — the S-trunk failure mode,
+DESIGN.md "Learning evidence" items 6-7), or (b) predicting real but
+misaligned structure. This tool separates them: it restores the newest
+checkpoint of a `tools/synthetic_fit.py` lineage and reports
+
+  - spatial-pattern correlation  corr(pred - mean, gt - mean) within
+    samples (does the net predict the FIELD's shape?),
+  - per-sample-mean correlation  (does it predict the global motion?),
+  - magnitude stats (|pred| vs |gt| — collapse shows as |pred| ~ 0).
+
+Run with the SAME model/data flags as the fit it inspects, e.g.:
+    python tools/fit_corr.py --model flownet_s --width-mult 0.5 \
+        --style affine --blobs 40 --max-shift 4 \
+        --out artifacts/synthetic_fit_cpu_s_affine.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--feature-scale", type=int, default=16)
+    ap.add_argument("--max-shift", type=float, default=4.0)
+    ap.add_argument("--style", default="blobs",
+                    choices=("noise", "blobs", "affine"))
+    ap.add_argument("--blobs", type=int, default=8)
+    ap.add_argument("--width-mult", type=float, default=1.0)
+    ap.add_argument("--model", default="flownet_s",
+                    choices=("flownet_s", "flownet_c", "inception_v3",
+                             "vgg16"))
+    ap.add_argument("--max-disp", type=int, default=4)
+    ap.add_argument("--corr-stride", type=int, default=2)
+    ap.add_argument("--num-train", type=int, default=8192)
+    ap.add_argument("--out", required=True,
+                    help="the fit's --out jsonl; the checkpoint lineage "
+                         "lives at <out>.ckpt")
+    args = ap.parse_args()
+
+    force_cpu_devices(args.devices)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepof_tpu.core.config import (
+        DataConfig,
+        ExperimentConfig,
+        LossConfig,
+        MeshConfig,
+        OptimConfig,
+        TrainConfig,
+    )
+    from deepof_tpu.data.datasets import SyntheticData
+    from deepof_tpu.models.registry import build_model
+    from deepof_tpu.parallel.mesh import build_mesh
+    from deepof_tpu.train.checkpoint import CheckpointManager
+    from deepof_tpu.train.evaluate import postprocess_flow
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+    from deepof_tpu.train.step import make_eval_fn
+
+    h = w = 64
+    cfg = ExperimentConfig(
+        name="fit_corr", model=args.model,
+        loss=LossConfig(weights=(16, 8, 4, 2, 1, 1)),
+        optim=OptimConfig(learning_rate=args.lr),
+        data=DataConfig(dataset="synthetic", image_size=(h, w),
+                        gt_size=(h, w), batch_size=args.batch),
+        mesh=MeshConfig(),
+        train=TrainConfig(seed=0, eval_amplifier=2.0, eval_clip=(-300, 250),
+                          eval_batch_size=8))
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data, num_train=args.num_train,
+                       feature_scale=args.feature_scale,
+                       max_shift=args.max_shift, style=args.style,
+                       n_blobs=args.blobs)
+    model_kw = ({"max_disp": args.max_disp, "corr_stride": args.corr_stride}
+                if args.model == "flownet_c" else {})
+    model = build_model(args.model, width_mult=args.width_mult, **model_kw)
+    tx = make_optimizer(cfg.optim, lambda s: args.lr)
+    state = create_train_state(model, jnp.zeros((args.batch, h, w, 6)), tx,
+                               seed=0)
+    ck = CheckpointManager(args.out + ".ckpt", keep=1, async_save=False)
+    st = ck.restore(state)
+    if st is None:
+        raise SystemExit(f"no checkpoint under {args.out}.ckpt")
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+    preds, gts = [], []
+    for bid in range(2):
+        b = ds.sample_val(8, bid)
+        out = eval_fn(st.params, b)
+        preds.append(postprocess_flow(np.asarray(out["flow"]), cfg,
+                                      b["flow"].shape[1:3]))
+        gts.append(b["flow"])
+    p, g = np.concatenate(preds), np.concatenate(gts)
+    pc = p - p.mean(axis=(1, 2), keepdims=True)
+    gc = g - g.mean(axis=(1, 2), keepdims=True)
+    spat = float((pc * gc).sum()
+                 / max(np.sqrt((pc ** 2).sum() * (gc ** 2).sum()), 1e-12))
+    pm, gm = p.mean(axis=(1, 2)), g.mean(axis=(1, 2))
+    pmc, gmc = pm - pm.mean(0), gm - gm.mean(0)
+    mean_corr = float((pmc * gmc).sum()
+                      / max(np.sqrt((pmc ** 2).sum() * (gmc ** 2).sum()),
+                            1e-12))
+    print(json.dumps({
+        "step": int(st.step),
+        "spatial_pattern_corr": round(spat, 4),
+        "per_sample_mean_corr": round(mean_corr, 4),
+        "pred_abs_mean": round(float(np.abs(pm).mean()), 4),
+        "gt_abs_mean": round(float(np.abs(gm).mean()), 4),
+        "pred_std": round(float(p.std()), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
