@@ -114,14 +114,51 @@ def main() -> None:
         "process_seed": process_seed(mesh, 123),
     }
 
+    def local_global(iteration):
+        gb = ds.sample_train(BATCH, iteration=iteration)
+        lb = {key: np.asarray(v)[rows] for key, v in gb.items()}
+        return put_global(lb, batch_sharding(mesh))
+
+    # --- AOT-compile EVERY collective program, then rendezvous, then
+    # execute. gloo's context init has a hard 30s kv-store deadline that
+    # fires at the FIRST collective *execution*; per-worker compile-time
+    # skew (AOT-cache hit vs miss, scheduler contention) routinely
+    # exceeds it (the r05 full-suite flake). Compiling all three legs
+    # first and crossing a coordination-service barrier (10 min budget,
+    # no gloo involved) brings both workers to the gloo key exchange
+    # within milliseconds of each other.
+    b = local_global(0)
+    step_exec = step.lower(state, b).compile()
+
+    kcfg = cfg.replace(train=dataclasses.replace(cfg.train, steps_per_call=2))
+    kstate = new_state()
+    kstep = make_train_step(model, kcfg, ds.mean, mesh)
+    g0 = ds.sample_train(BATCH, iteration=0)
+    g1 = ds.sample_train(BATCH, iteration=1)
+    stacked = {key: np.stack([np.asarray(g0[key])[rows],
+                              np.asarray(g1[key])[rows]]) for key in g0}
+    kb = put_global(stacked, stacked_batch_sharding(mesh))
+    kstep_exec = kstep.lower(kstate, kb).compile()
+
+    from jax.experimental import multihost_utils
+
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+    vb = ds.sample_val(BATCH, 0)
+    gvb = put_global_from_full(vb, mesh, batch_sharding(mesh))
+    eval_exec = eval_fn.lower(state.params, gvb).compile()
+
+    from jax._src import distributed
+
+    distributed.global_state.client.wait_at_barrier(
+        "mp_precollective", timeout_in_ms=600_000)
+
     # 2 train steps: each process loads ONLY its own rows of the
     # (deterministic) global batch; put_global assembles without any host
     # holding the full batch.
     for k in range(2):
-        gb = ds.sample_train(BATCH, iteration=k)
-        lb = {key: np.asarray(v)[rows] for key, v in gb.items()}
-        b = put_global(lb, batch_sharding(mesh))
-        state, m = step(state, b)
+        if k > 0:
+            b = local_global(k)
+        state, m = step_exec(state, b)
         results[f"step{k}_total"] = float(jax.device_get(m["total"]))
         results[f"step{k}_gradnorm"] = float(jax.device_get(m["grad_norm"]))
         flat, _ = flatten_util.ravel_pytree(state.params)
@@ -131,25 +168,8 @@ def main() -> None:
     # steps_per_call=2: stacked [K, local_B, ...] leaves under
     # P(None, "data") via make_array_from_process_local_data (the
     # non-leading sharded axis layout).
-    kcfg = cfg.replace(train=dataclasses.replace(cfg.train, steps_per_call=2))
-    kstate = new_state()
-    kstep = make_train_step(model, kcfg, ds.mean, mesh)
-    g0 = ds.sample_train(BATCH, iteration=0)
-    g1 = ds.sample_train(BATCH, iteration=1)
-    stacked = {key: np.stack([np.asarray(g0[key])[rows],
-                              np.asarray(g1[key])[rows]]) for key in g0}
-    kb = put_global(stacked, stacked_batch_sharding(mesh))
-    kstate, km = kstep(kstate, kb)
+    kstate, km = kstep_exec(kstate, kb)
     results["scan_totals"] = np.asarray(jax.device_get(km["total"])).tolist()
-
-    # allgathered eval: every host loads the same full val batch,
-    # contributes its rows, and gathers the outputs (train/loop.py's
-    # multi-host eval path).
-    from jax.experimental import multihost_utils
-
-    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
-    vb = ds.sample_val(BATCH, 0)
-    gvb = put_global_from_full(vb, mesh, batch_sharding(mesh))
     # assembly diagnostics: the global array each host sees must be the
     # full val batch, byte-identical to the host-local copy
     gsrc = np.asarray(multihost_utils.process_allgather(gvb["source"],
@@ -158,18 +178,28 @@ def main() -> None:
         np.array_equal(gsrc, np.asarray(vb["source"])))
     # eval with the UNTRAINED params isolates batch assembly from any
     # cross-runtime optimizer drift
-    out0 = eval_fn(new_state().params, gvb)
+    out0 = eval_exec(new_state().params, gvb)
     results["eval_init_total"] = float(np.asarray(
         multihost_utils.process_allgather(out0["total"], tiled=True)).ravel()[0])
-    out = eval_fn(state.params, gvb)
+    out = eval_exec(state.params, gvb)
     gathered = {k2: np.asarray(multihost_utils.process_allgather(v, tiled=True))
                 for k2, v in out.items()}
     results["eval_total"] = float(gathered["total"].ravel()[0])
     results["eval_flow_shape"] = list(gathered["flow"].shape)
     results["eval_flow_sum"] = float(np.abs(gathered["flow"]).sum())
 
-    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+    # Atomic publish BEFORE the distributed shutdown: the coordination
+    # service's shutdown barrier can fail under scheduler contention
+    # (observed r05: "Shutdown barrier has failed" -> FATAL after all
+    # work completed). A complete results file is the worker's success
+    # criterion; the parent treats a teardown-phase crash after both
+    # files exist as a pass.
+    tmp = os.path.join(outdir, f"proc{pid}.json.tmp")
+    with open(tmp, "w") as f:
         json.dump(results, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(outdir, f"proc{pid}.json"))
     jax.distributed.shutdown()
 
 
