@@ -86,16 +86,16 @@ while [ $i -lt 20 ]; do
         1) extra="--steps 30000 --lr-decay-every 4000 \
             --model flownet_c --max-disp 3 --corr-stride 1 --max-shift 8"
            tag=corr8 ;;
-        2) extra="--steps 300000 --lr-decay-every 40000 \
+        2) extra="--steps 30000 --lr-decay-every 4000 --batch 8 \
+            --model inception_v3 --style affine --max-shift 4 \
+            --curriculum-start 0.25 --curriculum-steps 3000"
+           tag=inc_affine ;;
+        3) extra="--steps 300000 --lr-decay-every 40000 \
             --model flownet_s --width-mult 0.5"
            tag=s_long ;;
-        3) extra="--steps 300000 --lr-decay-every 40000 \
+        *) extra="--steps 300000 --lr-decay-every 40000 \
             --model flownet_s --width-mult 0.5 --curriculum-steps 80000"
            tag=s_long_curr ;;
-        *) extra="--steps 300000 --lr-decay-every 40000 \
-            --model flownet_s --width-mult 0.5 --curriculum-steps 80000 \
-            --photometric census"
-           tag=s_long_census ;;
     esac
     echo "$(stamp) synthetic_fit TPU attempt $i rung=$tag" >> "$FLOG"
     # probe first in a throwaway subprocess; the fit itself has no wait loop
@@ -114,13 +114,15 @@ while [ $i -lt 20 ]; do
     rc=$?  # capture IMMEDIATELY: both `if cmd` and $(stamp) clobber $?
     if [ "$rc" -eq 0 ]; then
         echo "$(stamp) synthetic_fit TPU SUCCESS rung=$tag" >> "$FLOG"
-        if [ "$rung" -eq 1 ]; then
-            # the <1 px on-chip conversion is done — continue up the
-            # ladder to the parity-backbone long run instead of exiting
-            echo "$(stamp) corr8 converted; moving to parity rung" >> "$FLOG"
+        if [ "$rung" -lt 3 ]; then
+            # rung 1 (<1 px on-chip, corr path) and rung 2 (Inception
+            # parity backbone — the recipe PROVEN on CPU at r05:
+            # AEE 1.03 in 2.4k steps) each convert in minutes on chip;
+            # continue up the ladder to the S-trunk long run after
+            echo "$(stamp) rung $rung converted; next rung" >> "$FLOG"
             fit_ok=1
             fit_extra="--model flownet_c --max-disp 3 --corr-stride 1 --max-shift 8"
-            rung=2
+            rung=$((rung + 1))
             continue
         fi
         echo "$(stamp) parity rung converged rung=$tag" >> "$FLOG"
