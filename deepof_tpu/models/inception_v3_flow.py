@@ -26,22 +26,29 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .common import FlowDecoder, conv_init
+from .common import FlowDecoder, conv_init, scaled_width
 
 FLOW_SCALES = (10.0, 5.0, 2.5, 2.5, 1.25, 0.625)  # finest (pr1) first
 
 
 class _Conv(nn.Module):
-    """conv + bias + ReLU, SAME padding (slim default in the base)."""
+    """conv + bias + ReLU, SAME padding (slim default in the base).
+
+    `width_mult` scales the channel count (thin variants, same role as
+    FlowNetS.width_mult); 1.0 keeps the exact reference widths, so the
+    44.55M param-parity pin is untouched.
+    """
 
     features: int
     kernel: tuple[int, int] = (1, 1)
     stride: int = 1
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, self.kernel, strides=(self.stride, self.stride),
+        feats = scaled_width(self.features, self.width_mult)
+        x = nn.Conv(feats, self.kernel, strides=(self.stride, self.stride),
                     padding="SAME", kernel_init=conv_init, dtype=self.dtype)(x)
         return nn.relu(x)
 
@@ -59,17 +66,19 @@ class _InceptionA(nn.Module):
 
     pool_features: int
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         dt = self.dtype
-        b0 = _Conv(64, dtype=dt, name="b0_1x1")(x)
-        b1 = _Conv(48, dtype=dt, name="b1_1x1")(x)
-        b1 = _Conv(64, (5, 5), dtype=dt, name="b1_5x5")(b1)
-        b2 = _Conv(64, dtype=dt, name="b2_1x1")(x)
-        b2 = _Conv(96, (3, 3), dtype=dt, name="b2_3x3a")(b2)
-        b2 = _Conv(96, (3, 3), dtype=dt, name="b2_3x3b")(b2)
-        b3 = _Conv(self.pool_features, dtype=dt, name="b3_proj")(_avg_pool(x))
+        wm = self.width_mult
+        b0 = _Conv(64, dtype=dt, width_mult=wm, name="b0_1x1")(x)
+        b1 = _Conv(48, dtype=dt, width_mult=wm, name="b1_1x1")(x)
+        b1 = _Conv(64, (5, 5), dtype=dt, width_mult=wm, name="b1_5x5")(b1)
+        b2 = _Conv(64, dtype=dt, width_mult=wm, name="b2_1x1")(x)
+        b2 = _Conv(96, (3, 3), dtype=dt, width_mult=wm, name="b2_3x3a")(b2)
+        b2 = _Conv(96, (3, 3), dtype=dt, width_mult=wm, name="b2_3x3b")(b2)
+        b3 = _Conv(self.pool_features, dtype=dt, width_mult=wm, name="b3_proj")(_avg_pool(x))
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -77,14 +86,16 @@ class _ReductionA(nn.Module):
     """Mixed_6a: stride-2 reduction to 768."""
 
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         dt = self.dtype
-        b0 = _Conv(384, (3, 3), 2, dtype=dt, name="b0_3x3")(x)
-        b1 = _Conv(64, dtype=dt, name="b1_1x1")(x)
-        b1 = _Conv(96, (3, 3), dtype=dt, name="b1_3x3a")(b1)
-        b1 = _Conv(96, (3, 3), 2, dtype=dt, name="b1_3x3b")(b1)
+        wm = self.width_mult
+        b0 = _Conv(384, (3, 3), 2, dtype=dt, width_mult=wm, name="b0_3x3")(x)
+        b1 = _Conv(64, dtype=dt, width_mult=wm, name="b1_1x1")(x)
+        b1 = _Conv(96, (3, 3), dtype=dt, width_mult=wm, name="b1_3x3a")(b1)
+        b1 = _Conv(96, (3, 3), 2, dtype=dt, width_mult=wm, name="b1_3x3b")(b1)
         return jnp.concatenate([b0, b1, _max_pool(x)], axis=-1)
 
 
@@ -93,20 +104,22 @@ class _InceptionB(nn.Module):
 
     mid: int  # 128 / 160 / 192
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         dt, m = self.dtype, self.mid
-        b0 = _Conv(192, dtype=dt, name="b0_1x1")(x)
-        b1 = _Conv(m, dtype=dt, name="b1_1x1")(x)
-        b1 = _Conv(m, (1, 7), dtype=dt, name="b1_1x7")(b1)
-        b1 = _Conv(192, (7, 1), dtype=dt, name="b1_7x1")(b1)
-        b2 = _Conv(m, dtype=dt, name="b2_1x1")(x)
-        b2 = _Conv(m, (7, 1), dtype=dt, name="b2_7x1a")(b2)
-        b2 = _Conv(m, (1, 7), dtype=dt, name="b2_1x7a")(b2)
-        b2 = _Conv(m, (7, 1), dtype=dt, name="b2_7x1b")(b2)
-        b2 = _Conv(192, (1, 7), dtype=dt, name="b2_1x7b")(b2)
-        b3 = _Conv(192, dtype=dt, name="b3_proj")(_avg_pool(x))
+        wm = self.width_mult
+        b0 = _Conv(192, dtype=dt, width_mult=wm, name="b0_1x1")(x)
+        b1 = _Conv(m, dtype=dt, width_mult=wm, name="b1_1x1")(x)
+        b1 = _Conv(m, (1, 7), dtype=dt, width_mult=wm, name="b1_1x7")(b1)
+        b1 = _Conv(192, (7, 1), dtype=dt, width_mult=wm, name="b1_7x1")(b1)
+        b2 = _Conv(m, dtype=dt, width_mult=wm, name="b2_1x1")(x)
+        b2 = _Conv(m, (7, 1), dtype=dt, width_mult=wm, name="b2_7x1a")(b2)
+        b2 = _Conv(m, (1, 7), dtype=dt, width_mult=wm, name="b2_1x7a")(b2)
+        b2 = _Conv(m, (7, 1), dtype=dt, width_mult=wm, name="b2_7x1b")(b2)
+        b2 = _Conv(192, (1, 7), dtype=dt, width_mult=wm, name="b2_1x7b")(b2)
+        b3 = _Conv(192, dtype=dt, width_mult=wm, name="b3_proj")(_avg_pool(x))
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -114,16 +127,18 @@ class _ReductionB(nn.Module):
     """Mixed_7a: stride-2 reduction to 1280."""
 
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         dt = self.dtype
-        b0 = _Conv(192, dtype=dt, name="b0_1x1")(x)
-        b0 = _Conv(320, (3, 3), 2, dtype=dt, name="b0_3x3")(b0)
-        b1 = _Conv(192, dtype=dt, name="b1_1x1")(x)
-        b1 = _Conv(192, (1, 7), dtype=dt, name="b1_1x7")(b1)
-        b1 = _Conv(192, (7, 1), dtype=dt, name="b1_7x1")(b1)
-        b1 = _Conv(192, (3, 3), 2, dtype=dt, name="b1_3x3")(b1)
+        wm = self.width_mult
+        b0 = _Conv(192, dtype=dt, width_mult=wm, name="b0_1x1")(x)
+        b0 = _Conv(320, (3, 3), 2, dtype=dt, width_mult=wm, name="b0_3x3")(b0)
+        b1 = _Conv(192, dtype=dt, width_mult=wm, name="b1_1x1")(x)
+        b1 = _Conv(192, (1, 7), dtype=dt, width_mult=wm, name="b1_1x7")(b1)
+        b1 = _Conv(192, (7, 1), dtype=dt, width_mult=wm, name="b1_7x1")(b1)
+        b1 = _Conv(192, (3, 3), 2, dtype=dt, width_mult=wm, name="b1_3x3")(b1)
         return jnp.concatenate([b0, b1, _max_pool(x)], axis=-1)
 
 
@@ -131,21 +146,23 @@ class _InceptionC(nn.Module):
     """Mixed_7b/7c: expanded-filter-bank blocks, 2048 out."""
 
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x):
         dt = self.dtype
-        b0 = _Conv(320, dtype=dt, name="b0_1x1")(x)
-        b1 = _Conv(384, dtype=dt, name="b1_1x1")(x)
+        wm = self.width_mult
+        b0 = _Conv(320, dtype=dt, width_mult=wm, name="b0_1x1")(x)
+        b1 = _Conv(384, dtype=dt, width_mult=wm, name="b1_1x1")(x)
         b1 = jnp.concatenate(
-            [_Conv(384, (1, 3), dtype=dt, name="b1_1x3")(b1),
-             _Conv(384, (3, 1), dtype=dt, name="b1_3x1")(b1)], axis=-1)
-        b2 = _Conv(448, dtype=dt, name="b2_1x1")(x)
-        b2 = _Conv(384, (3, 3), dtype=dt, name="b2_3x3")(b2)
+            [_Conv(384, (1, 3), dtype=dt, width_mult=wm, name="b1_1x3")(b1),
+             _Conv(384, (3, 1), dtype=dt, width_mult=wm, name="b1_3x1")(b1)], axis=-1)
+        b2 = _Conv(448, dtype=dt, width_mult=wm, name="b2_1x1")(x)
+        b2 = _Conv(384, (3, 3), dtype=dt, width_mult=wm, name="b2_3x3")(b2)
         b2 = jnp.concatenate(
-            [_Conv(384, (1, 3), dtype=dt, name="b2_1x3")(b2),
-             _Conv(384, (3, 1), dtype=dt, name="b2_3x1")(b2)], axis=-1)
-        b3 = _Conv(192, dtype=dt, name="b3_proj")(_avg_pool(x))
+            [_Conv(384, (1, 3), dtype=dt, width_mult=wm, name="b2_1x3")(b2),
+             _Conv(384, (3, 1), dtype=dt, width_mult=wm, name="b2_3x1")(b2)], axis=-1)
+        b3 = _Conv(192, dtype=dt, width_mult=wm, name="b3_proj")(_avg_pool(x))
         return jnp.concatenate([b0, b1, b2, b3], axis=-1)
 
 
@@ -153,34 +170,36 @@ class InceptionV3Base(nn.Module):
     """Stem + Mixed blocks; returns the 6 decoder tap activations."""
 
     dtype: Any = jnp.float32
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x) -> dict[str, jnp.ndarray]:
         dt = self.dtype
+        wm = self.width_mult
         taps = {}
-        net = _Conv(32, (3, 3), 2, dtype=dt, name="Conv2d_1a_3x3")(x)
+        net = _Conv(32, (3, 3), 2, dtype=dt, width_mult=wm, name="Conv2d_1a_3x3")(x)
         taps["Conv2d_1a_3x3"] = net
-        net = _Conv(32, (3, 3), dtype=dt, name="Conv2d_2a_3x3")(net)
-        net = _Conv(64, (3, 3), dtype=dt, name="Conv2d_2b_3x3")(net)
+        net = _Conv(32, (3, 3), dtype=dt, width_mult=wm, name="Conv2d_2a_3x3")(net)
+        net = _Conv(64, (3, 3), dtype=dt, width_mult=wm, name="Conv2d_2b_3x3")(net)
         net = _max_pool(net)
         taps["MaxPool_3a_3x3"] = net
-        net = _Conv(80, dtype=dt, name="Conv2d_3b_1x1")(net)
-        net = _Conv(192, (3, 3), dtype=dt, name="Conv2d_4a_3x3")(net)
+        net = _Conv(80, dtype=dt, width_mult=wm, name="Conv2d_3b_1x1")(net)
+        net = _Conv(192, (3, 3), dtype=dt, width_mult=wm, name="Conv2d_4a_3x3")(net)
         net = _max_pool(net)
         taps["MaxPool_5a_3x3"] = net
-        net = _InceptionA(32, dtype=dt, name="Mixed_5b")(net)
-        net = _InceptionA(64, dtype=dt, name="Mixed_5c")(net)
-        net = _InceptionA(64, dtype=dt, name="Mixed_5d")(net)
+        net = _InceptionA(32, dtype=dt, width_mult=wm, name="Mixed_5b")(net)
+        net = _InceptionA(64, dtype=dt, width_mult=wm, name="Mixed_5c")(net)
+        net = _InceptionA(64, dtype=dt, width_mult=wm, name="Mixed_5d")(net)
         taps["Mixed_5d"] = net
-        net = _ReductionA(dtype=dt, name="Mixed_6a")(net)
-        net = _InceptionB(128, dtype=dt, name="Mixed_6b")(net)
-        net = _InceptionB(160, dtype=dt, name="Mixed_6c")(net)
-        net = _InceptionB(160, dtype=dt, name="Mixed_6d")(net)
-        net = _InceptionB(192, dtype=dt, name="Mixed_6e")(net)
+        net = _ReductionA(dtype=dt, width_mult=wm, name="Mixed_6a")(net)
+        net = _InceptionB(128, dtype=dt, width_mult=wm, name="Mixed_6b")(net)
+        net = _InceptionB(160, dtype=dt, width_mult=wm, name="Mixed_6c")(net)
+        net = _InceptionB(160, dtype=dt, width_mult=wm, name="Mixed_6d")(net)
+        net = _InceptionB(192, dtype=dt, width_mult=wm, name="Mixed_6e")(net)
         taps["Mixed_6e"] = net
-        net = _ReductionB(dtype=dt, name="Mixed_7a")(net)
-        net = _InceptionC(dtype=dt, name="Mixed_7b")(net)
-        net = _InceptionC(dtype=dt, name="Mixed_7c")(net)
+        net = _ReductionB(dtype=dt, width_mult=wm, name="Mixed_7a")(net)
+        net = _InceptionC(dtype=dt, width_mult=wm, name="Mixed_7b")(net)
+        net = _InceptionC(dtype=dt, width_mult=wm, name="Mixed_7c")(net)
         taps["Mixed_7c"] = net
         return taps
 
@@ -188,15 +207,22 @@ class InceptionV3Base(nn.Module):
 class InceptionV3Flow(nn.Module):
     flow_channels: int = 2
     dtype: Any = jnp.float32
+    # Thin-variant channel multiplier (1.0 = exact reference widths,
+    # 44.55M params — the param-parity pin). Sub-1 variants make the
+    # flagship's learning properties affordable to rerun (DESIGN.md
+    # "Learning evidence, r05").
+    width_mult: float = 1.0
 
     flow_scales: tuple[float, ...] = FLOW_SCALES
     max_downsample = 32  # five stride-2 stages; spatial-CP gradient-safety bound
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
-        taps = InceptionV3Base(dtype=self.dtype, name="encoder")(x)
+        taps = InceptionV3Base(dtype=self.dtype, width_mult=self.width_mult,
+                               name="encoder")(x)
         flows = FlowDecoder(
-            upconv_features=(512, 256, 128, 64, 32),
+            upconv_features=tuple(scaled_width(f, self.width_mult)
+                                  for f in (512, 256, 128, 64, 32)),
             scales=(2, 2, 1, 2, 2),  # Mixed_5d and MaxPool_5a share a size
             flow_channels=self.flow_channels,
             dtype=self.dtype,
