@@ -401,6 +401,53 @@ def test_flownet_c_learns_matching_below_zero_flow(tmp_path):
     assert res["aee"] < 0.85 * zero_epe, (res["aee"], zero_epe)
 
 
+def test_inception_learns_flow_below_zero_flow(tmp_path):
+    """The r05 flagship learning-evidence property, pinned: Inception-v3
+    flow (the model the reference actually trains,
+    `flyingChairsTrain.py:103`) descends WELL below the zero-flow AEE
+    under the default unsupervised recipe on the spatially varying
+    affine field with a sub-pixel curriculum start — where the
+    FlowNet-S trunk provably parks (corr(pred, gt) ~ 0, DESIGN.md
+    "Learning evidence, r05"). Thin variant (width 0.25) for CI cost;
+    both probe configs locked on by step ~2000 (full runs:
+    artifacts/synthetic_fit_cpu_inc_{affine: 0.883 px full width,
+    thin: 1.08 px, pin: 1.15 px}.jsonl). Early-exits at the bound, so
+    the typical cost is ~the lock-on point, not the cap."""
+    import dataclasses
+
+    cfg = _cfg(tmp_path)
+    cfg = cfg.replace(
+        model="inception_v3", width_mult=1.0,  # model built thin below
+        train=dataclasses.replace(cfg.train, eval_amplifier=2.0,
+                                  eval_clip=(-300.0, 250.0)))
+    mesh = build_mesh(cfg.mesh)
+    ds = SyntheticData(cfg.data, num_train=8192, max_shift=4.0,
+                       style="affine", n_blobs=40, feature_scale=16)
+    model = build_model("inception_v3", width_mult=0.25)
+    tx = make_optimizer(cfg.optim, lambda s: 5e-4)
+    state = create_train_state(model, jnp.zeros((8, H, W, 6)), tx, seed=0)
+    step = make_train_step(model, cfg, ds.mean, mesh)
+    eval_fn = make_eval_fn(model, cfg, ds.mean, mesh=mesh)
+
+    vflows = np.concatenate([ds.sample_val(8, i)["flow"] for i in range(2)])
+    zero_epe = float(np.sqrt((vflows ** 2).sum(-1)).mean())
+    bound = 0.9 * zero_epe
+    rng = np.random.RandomState(0)
+    best = float("inf")
+    for s in range(2600):
+        shift = min(0.25 + (4.0 - 0.25) * s / 1200.0, 4.0)
+        b = jax.device_put(ds.sample_train(8, rng=rng, max_shift=shift),
+                           batch_sharding(mesh))
+        state, _ = step(state, b)
+        # evals only once lock-on is possible; early-exit at the bound
+        if s >= 1399 and (s + 1) % 200 == 0:
+            best = min(best,
+                       evaluate_aee(eval_fn, state.params, ds, cfg)["aee"])
+            if best < bound:
+                break
+    assert best < bound, (best, zero_epe)
+
+
 def test_volume_train_step(tmp_path):
     cfg = _cfg(tmp_path, time_step=3)
     mesh = build_mesh(cfg.mesh)
