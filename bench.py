@@ -77,7 +77,22 @@ def _watchdog(fn, timeout_s: float, what: str):
 
 def _init_devices(timeout_s: float = 240.0):
     _import_compute()
-    return _watchdog(lambda: jax.devices(), timeout_s, "backend init")
+    devs = _watchdog(lambda: jax.devices(), timeout_s, "backend init")
+    # Persistent compilation cache for the TPU path (window-1 r03 spent
+    # ~10 of 47 live-tunnel minutes recompiling the same graphs per
+    # attempt). Enabled only off-cpu, and only after backend init so the
+    # gate can ask which backend this is: cross-process cache reads on
+    # this host's cpu jaxlib intermittently corrupt the heap (see
+    # TrainConfig.compile_cache). Also installs the hit/miss counters
+    # bench() surfaces, so a measurement line says whether its window
+    # paid XLA or loaded executables. Best-effort.
+    try:
+        if jax.default_backend() != "cpu":
+            from deepof_tpu.train.warmup import enable_compile_cache
+            enable_compile_cache()
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+    return devs
 
 
 PROBE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -92,6 +107,11 @@ LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # os._exit indirection so tests can observe orchestrate()'s terminal
 # paths without killing the pytest process.
 _exit = os._exit
+
+#: Exit code of the stale-fallback path: distinct from both success (0)
+#: and hard failure (1) so a driver can recognize — and must explicitly
+#: accept — a cached headline (ADVICE r04; BENCH_ALLOW_STALE=1 opts in).
+STALE_EXIT_CODE = 3
 
 
 def _plog(event: str) -> None:
@@ -206,22 +226,29 @@ def orchestrate(deadline_s: float | None = None) -> None:
         # Honest-but-not-blind fallback: the freshest chain-captured
         # headline, clearly marked stale with its own timestamp and
         # calibration context. value=0.0 is reserved for "no measurement
-        # exists at all".
+        # exists at all". The exit code stays NONZERO (rc=3) so a driver
+        # keying on exit status cannot mistake a cached number for a
+        # fresh one (ADVICE r04); exporting BENCH_ALLOW_STALE=1 is the
+        # explicit opt-in that turns the stale line into rc=0.
+        allow_stale = (os.environ.get("BENCH_ALLOW_STALE", "").strip().lower()
+                       not in ("", "0", "false", "no", "off"))
         _plog(f"orchestrate fallback last_good value="
               f"{lg['res'].get('pairs_per_sec_per_chip')} "
-              f"measured_at={lg.get('measured_at')}")
+              f"measured_at={lg.get('measured_at')} "
+              f"rc={0 if allow_stale else STALE_EXIT_CODE}")
         emit(lg["res"]["pairs_per_sec_per_chip"], _vs_baseline(lg["res"]),
              stale=True, measured_at=lg.get("measured_at"),
              **{k: lg["res"][k] for k in _EXTRA_KEYS if k in lg["res"]},
              error=err)
-        _exit(0)
+        _exit(0 if allow_stale else STALE_EXIT_CODE)
     emit(0.0, 0.0, error=err)
     _exit(1)
 
 
 _EXTRA_KEYS = ("matmul_tflops", "rtt_ms", "batch", "warp_impl",
                "steps_per_call", "model_tflops", "mfu_nominal",
-               "mfu_vs_matmul")
+               "mfu_vs_matmul", "compile_cache_requests",
+               "compile_cache_hits", "compile_cache_misses")
 
 
 def _save_last_good(res: dict) -> None:
@@ -284,17 +311,6 @@ def _import_compute() -> None:
         import jax.numpy as _jnp
         import numpy as _np
         jax, jnp, np = _jax, _jnp, _np
-        # Persistent compilation cache for the TPU path too (the CPU test
-        # mesh already enables it via force_cpu_devices): window-1 r03
-        # spent ~10 of 47 live-tunnel minutes recompiling the same
-        # graphs per attempt. Best-effort — harmless if the backend
-        # ignores it.
-        try:
-            from deepof_tpu.core.hostmesh import COMPILE_CACHE_DIR
-            jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:  # noqa: BLE001 - cache is an optimization only
-            pass
 
 
 def calibrate(n: int = 4096, reps: int = 10) -> dict:
@@ -459,6 +475,14 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     # section, the CLI) measures and persists last_good under the same
     # config; the orchestrator's retry ladder pins 1 to de-risk.
     spc = max(int(os.environ.get("BENCH_SPC") or 4), 1)
+    # cache accounting around everything that can compile (setup + the
+    # timed fn's first call): a warmed window shows misses == 0 and
+    # reaches measurement without paying XLA (DESIGN.md "Execution layer")
+    try:
+        from deepof_tpu.train.warmup import cache_delta
+        cache_watch = cache_delta()
+    except Exception:  # noqa: BLE001 - counters are observability only
+        cache_watch = None
     cfg, mesh, ds, model, state, step, b = headline_setup(
         model_name, batch, image_size, steps_per_call=spc,
         warp_impl=warp_impl)
@@ -469,6 +493,7 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     calls = max(steps // spc, 5)
     per_call, state, total = time_train_step(
         step, state, b, steps=calls, windows=windows, warmup=warmup)
+    cache_d = cache_watch.stats() if cache_watch is not None else None
     per_step = per_call / spc
     pairs_per_sec = batch / per_step
     per_chip = pairs_per_sec / n_chips
@@ -477,6 +502,14 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
            "n_chips": n_chips, "batch": batch, "steps_per_sec": 1.0 / per_step,
            "steps_per_call": spc,
            "warp_impl": cfg.loss.warp_impl, **calibrate()}
+    if cache_d is not None:
+        # requests disambiguates: misses == 0 with requests == 0 means
+        # the counters never saw a compile (cache disabled / listener
+        # dead), NOT that the window was warm — don't let a silent
+        # enable_compile_cache failure read as "compiled nothing"
+        res["compile_cache_requests"] = cache_d["requests"]
+        res["compile_cache_hits"] = cache_d["hits"]
+        res["compile_cache_misses"] = cache_d["misses"]
     # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
     # nominal chip peak and the concurrently measured matmul rate (the
     # latter cancels tunnel-condition swings — DESIGN.md).

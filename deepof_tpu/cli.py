@@ -7,6 +7,13 @@ Usage:
     python -m deepof_tpu eval  --preset sintel --data-path /data/sintel \
         --log-dir /tmp/run1          # restores latest checkpoint
     python -m deepof_tpu bench --model inception_v3
+    python -m deepof_tpu warmup --preset flyingchairs --synthetic \
+        --set train.steps_per_call=4   # AOT-compile into the on-disk cache
+
+`warmup` populates the persistent compilation cache (artifacts/xla_cache)
+for a config ahead of time — lower + compile only, no data movement, no
+step execution — so the next `train`/`bench` process for the same config
+starts hot (zero recompilation; see DESIGN.md "Execution layer").
 
 Any config field can be overridden with --set section.field=value, e.g.
     --set optim.learning_rate=1e-4 --set train.num_epochs=10
@@ -119,6 +126,13 @@ def main(argv=None) -> int:
     p_cfg = sub.add_parser("config", help="print the resolved config")
     _add_common(p_cfg)
 
+    p_warm = sub.add_parser(
+        "warmup", help="AOT-compile a config's train+eval executables into "
+                       "the persistent compile cache (no execution)")
+    _add_common(p_warm)
+    p_warm.add_argument("--no-eval", action="store_true",
+                        help="skip the eval executable")
+
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     p_bench.add_argument("--model", default="inception_v3")
     p_bench.add_argument("--batch", type=int, default=16)
@@ -162,6 +176,26 @@ def main(argv=None) -> int:
         import jax
 
         jax.distributed.initialize()  # coordinator/process env-configured
+
+    if args.cmd == "warmup":
+        from .train.warmup import enable_for_config, warmup_compile
+
+        # the verb's sole purpose is populating the cache: refuse to
+        # silently pay minutes of XLA and persist nothing. On cpu the
+        # auto default disables the cache (TrainConfig.compile_cache —
+        # cross-process read corruption on this host's jaxlib), so the
+        # user must opt in explicitly.
+        if enable_for_config(cfg) is None:
+            print("warmup: persistent compile cache is not active for "
+                  "this config/backend (cpu auto-disables it; add --set "
+                  "train.compile_cache=true to opt in) — nothing would "
+                  "be persisted, refusing to compile", file=sys.stderr)
+            return 2
+        res = warmup_compile(cfg, include_eval=not args.no_eval)
+        print(json.dumps(res))
+        # nonzero when the cache was already warm is WRONG here — a warm
+        # cache is the goal; rc reflects only "did warmup complete"
+        return 0
 
     if args.cmd == "predict":
         from .predict import predict_pairs

@@ -196,6 +196,29 @@ class TrainConfig:
     # or remote device transports (DESIGN.md "Benchmark honesty") — at
     # the cost of log/eval granularity rounding up to a multiple of K.
     steps_per_call: int = 1
+    # --- Latency-hiding execution layer (DESIGN.md "Execution layer") ---
+    # Persistent on-disk XLA compilation cache: a process whose graphs
+    # were compiled before (same config, jax/XLA version, backend) loads
+    # executables instead of recompiling — minutes saved per cold start
+    # on a scarce tunnel window. The `warmup` CLI verb populates it
+    # ahead of time (train/warmup.py). None = auto: enabled on
+    # accelerator backends (the tunnel-window target), DISABLED on cpu —
+    # this host's grafted jaxlib intermittently corrupts the heap when
+    # deserializing cache entries written by another process on the cpu
+    # backend (~50% of warm CLI runs: spurious NaN rollbacks, rc=139/134;
+    # bisected r06 — writes and cache-off runs are clean). True forces it
+    # on (tests, opt-in CPU experiments); False forces it off.
+    compile_cache: bool | None = None
+    # Cache location; "" = <repo>/artifacts/xla_cache (hostmesh.py).
+    compile_cache_dir: str = ""
+    # Max in-flight async metric fetches: the loop dispatches the next
+    # step(s) while previous calls' metric values are still in transit,
+    # draining them on a background consumer. 0 = fetch synchronously
+    # (the pre-r06 serial dispatch->fetch->dispatch loop). Bounded depth
+    # keeps the dispatch clock honest: a full queue blocks dispatch, so
+    # host-side progress can never run more than `pipeline_depth` calls
+    # ahead of device completion (DESIGN.md "Benchmark honesty").
+    pipeline_depth: int = 2
 
 
 @dataclass(frozen=True)

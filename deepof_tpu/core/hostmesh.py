@@ -20,9 +20,12 @@ import re
 # portable — XLA loads AOT results compiled on a different machine of
 # the same ISA family with a benign `prefer-no-scatter/gather` feature-
 # hint warning (observed across the r03->r04 host change).
+# Lives under artifacts/ with the other cross-session state so one
+# rsync of artifacts/ carries a warm cache to a fresh host; the warmup
+# path (train/warmup.py) populates it ahead of a tunnel window.
 COMPILE_CACHE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    ".jax_cache")
+    "artifacts", "xla_cache")
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
@@ -51,3 +54,17 @@ def force_cpu_devices(n: int = 8) -> None:
         jax.config.update("jax_platforms", "cpu")
         xla_bridge._backend_factories.pop("axon", None)
     jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+    # Keep jax's 1 s min-compile-time default: persisting sub-second
+    # entries was tried and reverted — serializing thousands of tiny CPU
+    # executables (jaxlib 0.4.37) intermittently aborts/segfaults the
+    # process mid-suite (background cache-writer threads racing dispatch;
+    # reproduced with an empty cache dir, gone at the default threshold).
+    # The multi-second model/step compiles that dominate cold starts all
+    # clear 1 s and are exactly what the warmup path needs cached.
+    # Known residual risk (r06 bisect, TrainConfig.compile_cache): cpu
+    # cache READS of entries written by another process intermittently
+    # corrupt the heap on this jaxlib. For the suite that means slow-tier
+    # tests re-reading a previous session's entries; accepted here for
+    # the ~35 min/session compile saving — the suite has been empirically
+    # stable — while the CLI/bench default (auto) stays off on cpu.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

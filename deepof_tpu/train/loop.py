@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 
 import numpy as np
 import jax
@@ -25,10 +26,17 @@ from ..models.registry import build_model
 from ..parallel.mesh import batch_sharding, build_mesh
 from .checkpoint import CheckpointManager
 from .evaluate import evaluate_aee, evaluate_ucf101
-from .metrics_log import MetricsLogger, ProfilerSession, StepTimer
+from .metrics_log import (
+    AsyncFetcher,
+    MetricsLogger,
+    ProfilerSession,
+    StepTimer,
+    SyncFetcher,
+)
 from .schedule import step_decay_schedule
 from .state import create_train_state, make_optimizer
 from .step import make_eval_fn, make_train_step
+from .warmup import cache_delta, enable_for_config
 
 
 # Early-preemption latch (ADVICE r03): model build + the first TPU
@@ -88,6 +96,11 @@ class Trainer:
     def __init__(self, cfg: ExperimentConfig, dataset=None, mesh=None,
                  profile: bool = False):
         self.cfg = cfg
+        # Persistent compile cache BEFORE any compile (init, train, eval):
+        # a process whose config was warmed (`deepof_tpu warmup`) or simply
+        # run before loads executables instead of recompiling — the
+        # execution layer's "start hot" half (train/warmup.py).
+        enable_for_config(cfg)
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         self.dataset = dataset if dataset is not None else build_dataset(cfg.data)
         t = cfg.data.time_step
@@ -282,8 +295,27 @@ class Trainer:
                 it_holder["i"] += 1
             return {key: _stack([b[key] for b in bs]) for key in bs[0]}
 
-        prefetch = Prefetcher(produce, depth=cfg.data.prefetch, sharding=sharding)
         timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
+        # stage=True: the next (super-)batch is transferred AND resident
+        # on device while the current call's scan executes, its wait spent
+        # on the prefetch thread and accounted as the `put` phase.
+        prefetch = Prefetcher(produce, depth=cfg.data.prefetch,
+                              sharding=sharding, stage=True,
+                              phase_cb=timer.phase)
+        # In-flight metrics pipelining (DESIGN.md "Execution layer"):
+        # depth > 0 drains value fetches on a background consumer so the
+        # next dispatch never waits on the previous fetch's RTT; the
+        # bounded queue blocks dispatch at `depth` in-flight calls,
+        # keeping host progress honest. depth 0 = serial fetch inline.
+        depth = max(cfg.train.pipeline_depth, 0)
+        fetcher = (AsyncFetcher(depth=depth, timer=timer) if depth > 0
+                   else SyncFetcher(timer=timer))
+        # Set by the fetch callback when a fetched step is non-finite;
+        # the main loop converts it into a rollback at the next boundary
+        # (at most `depth` extra dispatched calls late — all discarded by
+        # the checkpoint restore, so divergence handling is unchanged).
+        nan_event: dict = {"m": None}
+        streak = {"ok": False}  # a fetched finite step resets the NaN streak
         last_eval: dict[str, float] = {}
         # Preemption-graceful stop (SURVEY.md §5.3): TPU pods get SIGTERM
         # before eviction; the reference dies losing everything since its
@@ -341,24 +373,53 @@ class Trainer:
                 a = np.asarray(v)
                 return float(a) if a.ndim == 0 else float(a[-1])
 
+            def _on_metrics(tag, m_host):
+                """Fetch-completion consumer: NaN triage + the train log
+                record. Runs on the fetcher thread (or inline at depth 0)
+                once the device values for `tag`'s step have ARRIVED —
+                the honest value-fetch clock (DESIGN.md)."""
+                gs, ep, log_due_ = tag
+                if cfg.train.nan_guard and not np.isfinite(
+                        np.asarray(m_host["total"])).all():
+                    nan_event["m"] = (gs, m_host)
+                    return  # never log a diverged record
+                streak["ok"] = True
+                if log_due_:
+                    self.logger.log(
+                        "train", gs, epoch=ep,
+                        loss=_scalar_last(m_host["total"]),
+                        lr=float(self.schedule(gs - 1)),
+                        grad_norm=_scalar_last(m_host["grad_norm"]),
+                        **{key: _scalar_last(v) for key, v in m_host.items()
+                           if key in ("action_loss", "accuracy")},
+                        **timer.rates(), **timer.phases())
+
             gstep = start_step
             consecutive_nans = 0
             metrics = None
             while gstep < total_steps and stop_sig["sig"] is None:
+                t0 = time.perf_counter()
                 batch = prefetch.get()
+                timer.phase("assemble", time.perf_counter() - t0)
+                t0 = time.perf_counter()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
-                    import time as _time
-
-                    t0 = _time.perf_counter()
+                    cache_watch = cache_delta()
                     self.state, metrics = self.train_step(self.state, batch)
                     jax.block_until_ready(metrics["total"])
+                    dc = cache_watch.stats()
+                    # hit/miss counters surfaced in metrics: a warmed
+                    # process shows compile_cache_misses == 0 here
                     self.logger.log(
                         "info", gstep + k,
                         message=f"first step (compile + run): "
-                                f"{_time.perf_counter() - t0:.1f}s")
+                                f"{time.perf_counter() - t0:.1f}s",
+                        compile_cache_requests=dc["requests"],
+                        compile_cache_hits=dc["hits"],
+                        compile_cache_misses=dc["misses"])
                     first_step = False
                 else:
                     self.state, metrics = self.train_step(self.state, batch)
+                timer.phase("dispatch", time.perf_counter() - t0)
                 timer.tick(k)
                 prev, gstep = gstep, gstep + k
                 epoch = gstep // self.steps_per_epoch
@@ -374,42 +435,50 @@ class Trainer:
 
                 # One host fetch serves the NaN guard, logging, and the
                 # pre-checkpoint health check (per-metric fetches would
-                # each pay a transport round trip — DESIGN.md).
-                m_host = (jax.device_get(metrics)
-                          if (log_due or eval_due or ckpt_due) else None)
+                # each pay a transport round trip — DESIGN.md). The fetch
+                # drains in the background: the next iteration's dispatch
+                # proceeds while these values are still in transit.
+                if log_due or eval_due or ckpt_due:
+                    fetcher.submit((gstep, epoch, log_due), metrics,
+                                   _on_metrics)
 
-                # NaN guard runs on every host-visible step (log, eval, or
-                # checkpoint), so divergence never reaches an eval record
-                # and a NaN state is never saved as a rollback target; at
-                # most log_every-1 steps of NaN training are lost.
-                if m_host is not None and cfg.train.nan_guard:
-                    if not np.isfinite(np.asarray(m_host["total"])).all():
-                        self._rollback(gstep)
-                        gstep = int(self.state.step)
-                        # discarded steps must not count toward throughput
-                        # (rewind to the restored checkpoint's snapshot);
-                        # log/eval/ckpt boundaries between the rollback
-                        # target and the NaN step will re-fire as gstep
-                        # re-crosses them (duplicate step records downstream)
-                        timer.rewind(ckpt_mark)
-                        consecutive_nans += 1
-                        if consecutive_nans >= 3:
-                            raise FloatingPointError(
-                                f"loss diverged to NaN {consecutive_nans} "
-                                f"consecutive times around step {gstep}; "
-                                "rollback is not recovering — aborting")
-                        continue
+                # Sync points: eval and checkpoint decisions must see every
+                # host-visible metric first, so divergence never reaches an
+                # eval record and a NaN state is never saved as a rollback
+                # target; at most log_every-1 + depth*K steps of NaN
+                # training are lost (all rewound by the restore).
+                if eval_due or ckpt_due or nan_event["m"] is not None:
+                    fetcher.drain()
+
+                if nan_event["m"] is not None:
+                    # a NaN callback may land between the drain trigger
+                    # above and this read; drain again (no-op when already
+                    # drained) so every in-flight fetch — possibly from a
+                    # step dispatched off the diverged state — lands
+                    # before the rewind, never after it
+                    fetcher.drain()
+                    nan_step, _ = nan_event["m"]
+                    nan_event["m"] = None
+                    streak["ok"] = False
+                    self._rollback(nan_step)
+                    gstep = int(self.state.step)
+                    # discarded steps must not count toward throughput
+                    # (rewind to the restored checkpoint's snapshot);
+                    # log/eval/ckpt boundaries between the rollback
+                    # target and the NaN step will re-fire as gstep
+                    # re-crosses them (duplicate step records downstream)
+                    timer.rewind(ckpt_mark)
+                    consecutive_nans += 1
+                    if consecutive_nans >= 3:
+                        raise FloatingPointError(
+                            f"loss diverged to NaN {consecutive_nans} "
+                            f"consecutive times around step {gstep}; "
+                            "rollback is not recovering — aborting")
+                    continue
+                if streak["ok"]:
+                    streak["ok"] = False
                     consecutive_nans = 0
 
-                if log_due:
-                    self.logger.log(
-                        "train", gstep, epoch=epoch,
-                        loss=_scalar_last(m_host["total"]),
-                        lr=float(self.schedule(gstep - 1)),
-                        grad_norm=_scalar_last(m_host["grad_norm"]),
-                        **{key: _scalar_last(v) for key, v in m_host.items()
-                           if key in ("action_loss", "accuracy")},
-                        **timer.rates())
                 if eval_due:
                     last_eval = self.evaluate(dump=cfg.train.dump_visuals)
                     self.logger.log("eval", gstep, epoch=epoch, **last_eval)
@@ -419,6 +488,16 @@ class Trainer:
                     ckpt_mark = timer.mark()
                     timer.pause()
             self.profiler.maybe_stop()
+            # all in-flight NaN checks land before finalize — but bounded:
+            # a consumer wedged in a dead-tunnel device_get must not hang
+            # this path away from the finally's close()/ckpt.finalize()
+            drained = fetcher.drain(timeout=120.0)
+            if not drained:
+                self.logger.log(
+                    "warn", gstep,
+                    message="metrics fetch still in flight after 120s at "
+                            "finalize (hung device?); final state cannot "
+                            "be NaN-checked — skipping the final save")
             if stop_sig["sig"] is not None:
                 self.logger.log(
                     "warn", gstep,
@@ -429,12 +508,17 @@ class Trainer:
             # host-visible NaN check has seen; saving it unchecked would
             # make a diverged state the newest checkpoint and defeat both
             # auto-resume and _rollback.
-            final_ok = True
-            if cfg.train.nan_guard and metrics is not None:
+            final_ok = drained and nan_event["m"] is None
+            if final_ok and cfg.train.nan_guard and metrics is not None:
                 total = np.asarray(jax.device_get(metrics["total"]))
                 final_ok = bool(np.isfinite(total).all())
             if final_ok:
                 self.ckpt.save(self.state)
+            elif not drained:
+                # hung device: the rollback below would also touch the
+                # device (restore device_puts params); leave state as-is —
+                # the newest committed checkpoint stays the resume point
+                pass
             else:
                 # don't just suppress the save: leave self.state consistent
                 # with the newest (healthy) checkpoint so callers that keep
@@ -447,6 +531,7 @@ class Trainer:
                             "back to the last good checkpoint instead of "
                             "saving the diverged state")
         finally:
+            fetcher.close()
             prefetch.close()
             self.ckpt.finalize()  # commit any in-flight async save
             # restore only AFTER finalize(): the final async-save commit
@@ -462,8 +547,11 @@ class Trainer:
                 if restore is None or restore is _EARLY_SIGTERM.get("handler"):
                     restore = signal.SIG_DFL
                 signal.signal(signal.SIGTERM, restore)
-        rates = timer.rates()
-        return {**last_eval, **rates}
+        # phases + fetcher stats travel with the rates: bench logs show
+        # where host time went (assemble/put/dispatch/fetch) and how much
+        # overlap the pipelined drain actually achieved (max_in_flight).
+        return {**last_eval, **timer.rates(), **timer.phases(),
+                **{f"pipeline_{k}": v for k, v in fetcher.stats().items()}}
 
     def _rollback(self, step: int) -> None:
         restored = self.ckpt.restore(self.state)

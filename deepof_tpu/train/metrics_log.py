@@ -1,15 +1,25 @@
-"""Structured metrics logging + step timing.
+"""Structured metrics logging + step timing + the async metrics drain.
 
 Replaces the reference's print-only observability (SURVEY.md §5.5): every
 record is one JSON line (machine-parseable, the `analyze_test_loss.py`
 replacement reads it back), mirrored to stdout. StepTimer reports
-steps/sec and image-pairs/sec/chip — the BASELINE.json north-star metric.
+steps/sec and image-pairs/sec/chip — the BASELINE.json north-star metric —
+plus per-phase host time (assemble / put / dispatch / fetch) so dispatch/
+fetch overlap is verifiable in CI and readable in bench logs.
+
+AsyncFetcher is the loop's latency-hiding half (DESIGN.md "Execution
+layer"): on a 67-90 ms-RTT tunnel a synchronous `device_get` between
+dispatches serializes dispatch->fetch->dispatch; draining metric values on
+a bounded background consumer lets the next super-batch dispatch while the
+previous call's fetch is still in flight.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
 
 import jax
@@ -35,17 +45,22 @@ class MetricsLogger:
             os.makedirs(log_dir, exist_ok=True)
             self._f = open(self.path, "a", buffering=1)
         self.echo = echo and self._primary
+        # train records arrive from the AsyncFetcher consumer thread while
+        # info/eval/warn records come from the main loop — serialize writes
+        # so jsonl lines never interleave mid-record
+        self._lock = threading.Lock()
 
     def log(self, kind: str, step: int, **metrics) -> None:
         if not self._primary:
             return
         rec = {"kind": kind, "step": int(step), "time": time.time()}
         rec.update({k: _scalarize(v) for k, v in metrics.items()})
-        self._f.write(json.dumps(rec) + "\n")
-        if self.echo:
-            brief = {k: (round(v, 6) if isinstance(v, float) else v)
-                     for k, v in rec.items() if k != "time"}
-            print(brief, flush=True)
+        with self._lock:
+            self._f.write(json.dumps(rec) + "\n")
+            if self.echo:
+                brief = {k: (round(v, 6) if isinstance(v, float) else v)
+                         for k, v in rec.items() if k != "time"}
+                print(brief, flush=True)
 
     def close(self) -> None:
         if self._primary:
@@ -58,6 +73,14 @@ class StepTimer:
     The first tick after construction or `pause()` only arms the timer, so
     the compile step and any paused-over work (eval sweeps, checkpoint
     saves) are excluded from the rates.
+
+    `phase(name, dt)` additionally accumulates per-phase host time — the
+    dispatch-timeline instrument: `assemble` (waiting on the prefetcher),
+    `put` (host->device staging, recorded by the prefetch thread),
+    `dispatch` (the async step call), `fetch` (device->host value reads,
+    recorded by the AsyncFetcher consumer). Under full overlap,
+    fetch time stops appearing on the main thread's critical path while
+    still being accounted here.
     """
 
     def __init__(self, items_per_step: int, n_chips: int = 1):
@@ -66,6 +89,23 @@ class StepTimer:
         self._last: float | None = None
         self._elapsed = 0.0
         self._steps = 0
+        self._phases: dict[str, float] = {}
+        self._phase_counts: dict[str, int] = {}
+
+    def phase(self, name: str, seconds: float) -> None:
+        """Accumulate host seconds spent in a named loop phase. Called
+        from the main loop AND the prefetch/fetch threads — distinct
+        names per thread, so the GIL-atomic dict ops suffice."""
+        self._phases[name] = self._phases.get(name, 0.0) + seconds
+        self._phase_counts[name] = self._phase_counts.get(name, 0) + 1
+
+    def phases(self) -> dict[str, float]:
+        """Per-phase totals, `phase_<name>_s` keyed (log/bench-ready).
+        Snapshot first: called from the fetcher thread while the main
+        loop may be inserting a new phase key (C-level dict copy is
+        atomic under the GIL; iterating the live dict is not)."""
+        return {f"phase_{k}_s": round(v, 4)
+                for k, v in sorted(dict(self._phases).items())}
 
     def tick(self, n: int = 1) -> None:
         """Record n completed steps (n>1 for steps_per_call batched calls)."""
@@ -90,6 +130,7 @@ class StepTimer:
 
     def reset(self) -> None:
         self._last, self._elapsed, self._steps = None, 0.0, 0
+        self._phases, self._phase_counts = {}, {}
 
     def mark(self) -> tuple[float, int]:
         """Snapshot for `rewind` — taken when a checkpoint is saved."""
@@ -100,6 +141,157 @@ class StepTimer:
         rollback discards those steps; keeping them would skew rates)."""
         self._elapsed, self._steps = mark
         self._last = None
+
+
+class AsyncFetcher:
+    """Bounded-depth background drain of device metric values.
+
+    The main loop `submit()`s a (tag, device pytree, callback) and keeps
+    dispatching; a consumer thread fetches the values (`jax.device_get`
+    blocks until the step that produced them completes) and runs the
+    callback with the host pytree. The in-flight bound is the honesty
+    mechanism (DESIGN.md "Benchmark honesty"): `submit()` blocks while
+    `depth` submitted-but-unfetched calls are outstanding (counted under
+    a condition variable, so admission and the `max_in_flight` witness
+    are race-free), and every recorded fetch duration is a *completed*
+    value fetch — the only clock this repo trusts. The queue itself is
+    unbounded so `close()` can always enqueue its stop sentinel — even
+    when the consumer is wedged in a hung `device_get` (dead tunnel),
+    teardown proceeds to checkpoint finalization instead of hanging.
+
+    Callback/fetch exceptions are re-raised on the next submit()/drain()
+    (the Prefetcher's surface-on-get idiom). `stats()` reports completed
+    fetch count, total fetch seconds, and the max observed in-flight
+    depth — the overlap witness the CPU pipelining test pins.
+    """
+
+    _STOP = object()
+
+    def __init__(self, depth: int = 2, fetch_fn=None, timer: StepTimer | None = None):
+        self._fetch = fetch_fn if fetch_fn is not None else jax.device_get
+        self._timer = timer
+        self._depth = max(depth, 1)
+        self._q: queue.Queue = queue.Queue()  # unbounded; _cv is the bound
+        self._exc: BaseException | None = None
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._max_in_flight = 0
+        self._fetches = 0
+        self._fetch_s = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                self._q.task_done()
+                return
+            tag, tree, callback = item
+            try:
+                t0 = time.perf_counter()
+                host = self._fetch(tree)
+                dt = time.perf_counter() - t0
+                with self._cv:
+                    self._fetches += 1
+                    self._fetch_s += dt
+                if self._timer is not None:
+                    self._timer.phase("fetch", dt)
+                callback(tag, host)
+            except BaseException as e:  # noqa: BLE001 - surfaced on submit/drain
+                self._exc = e
+            finally:
+                with self._cv:
+                    self._in_flight -= 1
+                    self._cv.notify()
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, tag, tree, callback) -> None:
+        """Enqueue a fetch; blocks while `depth` fetches are in flight."""
+        self._raise_pending()
+        # admission and accounting are one atomic section: the counter
+        # can never go negative or miss a peak, and a submit blocked in
+        # wait() is by definition NOT in flight (that block is the bound)
+        with self._cv:
+            while self._in_flight >= self._depth:
+                self._cv.wait()
+            self._in_flight += 1
+            self._max_in_flight = max(self._max_in_flight, self._in_flight)
+        self._q.put((tag, tree, callback))
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted fetch has completed and its
+        callback has run (called before eval / checkpoint / rollback so
+        those decisions see all host-visible metrics). With a timeout
+        (the finalize path, where a consumer wedged in a dead-tunnel
+        device_get must not hang teardown away from ckpt.finalize()),
+        gives up after `timeout` seconds and returns False; mid-loop
+        barriers pass None — there a hung fetch means a hung device and
+        the loop could not proceed anyway."""
+        if timeout is None:
+            self._q.join()
+        else:
+            deadline = time.monotonic() + timeout
+            with self._q.all_tasks_done:
+                while self._q.unfinished_tasks:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._q.all_tasks_done.wait(remaining)
+        self._raise_pending()
+        return True
+
+    def stats(self) -> dict[str, float]:
+        with self._cv:
+            return {"fetches": self._fetches,
+                    "fetch_s": round(self._fetch_s, 4),
+                    "max_in_flight": self._max_in_flight}
+
+    def close(self) -> None:
+        # never blocks: the queue is unbounded, so a wedged consumer
+        # (hung device_get on a dead tunnel) can't stall teardown — the
+        # daemon thread is abandoned after the join timeout and fit()'s
+        # finally still reaches prefetch.close() / ckpt.finalize()
+        self._q.put(self._STOP)
+        self._thread.join(timeout=5.0)
+
+
+class SyncFetcher:
+    """Depth-0 stand-in: fetch + callback inline on the caller's thread
+    (the pre-r06 serial dispatch->fetch->dispatch loop, selectable via
+    `TrainConfig.pipeline_depth = 0`). Same interface as AsyncFetcher so
+    the train loop has one code path."""
+
+    def __init__(self, fetch_fn=None, timer: StepTimer | None = None):
+        self._fetch = fetch_fn if fetch_fn is not None else jax.device_get
+        self._timer = timer
+        self._fetches = 0
+        self._fetch_s = 0.0
+
+    def submit(self, tag, tree, callback) -> None:
+        t0 = time.perf_counter()
+        host = self._fetch(tree)
+        dt = time.perf_counter() - t0
+        self._fetches += 1
+        self._fetch_s += dt
+        if self._timer is not None:
+            self._timer.phase("fetch", dt)
+        callback(tag, host)
+
+    def drain(self, timeout: float | None = None) -> bool:
+        return True
+
+    def stats(self) -> dict[str, float]:
+        return {"fetches": self._fetches, "fetch_s": round(self._fetch_s, 4),
+                "max_in_flight": 1 if self._fetches else 0}
+
+    def close(self) -> None:
+        pass
 
 
 class ProfilerSession:

@@ -1,0 +1,256 @@
+"""Persistent compile cache + AOT warmup: start every process hot.
+
+The TPU behind this repo is reached through a scarce, flaky tunnel
+window (DESIGN.md "Benchmark honesty"); r03 lost a live window
+*mid-compile* and r04 converted zero measurement attempts. The fix is
+to make XLA compilation a once-per-config cost instead of a
+once-per-process cost:
+
+- `enable_compile_cache()` points jax's on-disk compilation cache at
+  `artifacts/xla_cache/` (hostmesh.COMPILE_CACHE_DIR) and installs
+  hit/miss counters, so "this process compiled nothing" is a checkable
+  fact, not a hope.
+- `warmup_compile(cfg)` AOT-lowers and compiles the train + eval
+  executables for a config from shape specs alone — no training data
+  movement, no step execution — populating the cache ahead of a tunnel
+  window (`python -m deepof_tpu warmup ...`).
+
+What the cache does and doesn't persist: entries are keyed by the
+lowered HLO, compile options (shardings, donation), backend, and the
+jax/XLA version — a config/jax upgrade misses cleanly (recompiles,
+never loads stale executables), and CPU entries never serve TPU
+processes. Cross-host reuse within the same ISA family works (observed
+r03->r04 host change, benign feature-hint warning).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core.config import ExperimentConfig
+from ..core.hostmesh import COMPILE_CACHE_DIR
+
+# jax.monitoring event names emitted by jax/_src/compiler.py for every
+# compile request that consults the persistent cache, and for each hit.
+# misses = requests - hits (jax emits no dedicated miss event).
+_EVENT_REQUESTS = "/jax/compilation_cache/compile_requests_use_cache"
+_EVENT_HITS = "/jax/compilation_cache/cache_hits"
+
+_counts = {"requests": 0, "hits": 0}
+_listener_installed = False
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _EVENT_REQUESTS:
+        _counts["requests"] += 1
+    elif event == _EVENT_HITS:
+        _counts["hits"] += 1
+
+
+def install_cache_counters() -> None:
+    """Idempotently register the hit/miss counting listener."""
+    global _listener_installed
+    if not _listener_installed:
+        jax.monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+
+
+def cache_stats() -> dict[str, int]:
+    """Cumulative process-wide counters since install_cache_counters()."""
+    return {"requests": _counts["requests"], "hits": _counts["hits"],
+            "misses": _counts["requests"] - _counts["hits"]}
+
+
+class cache_delta:
+    """Measures cache activity of a code region: requests/hits/misses
+    attributable to the region (counters are process-cumulative).
+    Usable as a context manager (`with cache_delta() as d: ...;
+    d.stats()`) or bare (`d = cache_delta(); ...; d.stats()`) — the
+    snapshot is taken at construction and refreshed by __enter__."""
+
+    def __init__(self) -> None:
+        install_cache_counters()
+        self._start = cache_stats()
+
+    def __enter__(self) -> "cache_delta":
+        self._start = cache_stats()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._end = cache_stats()
+
+    def stats(self) -> dict[str, int]:
+        end = getattr(self, "_end", None) or cache_stats()
+        return {k: end[k] - self._start[k] for k in end}
+
+
+def enable_compile_cache(cache_dir: str | None = None,
+                         min_compile_time_secs: float = 1.0) -> str:
+    """Enable jax's on-disk compilation cache and the hit/miss counters.
+
+    min_compile_time_secs stays at jax's 1 s default: sub-second
+    persistence was tried and reverted (hostmesh.py — serializing
+    thousands of tiny CPU executables intermittently crashes jaxlib
+    0.4.37), and the model/step compiles that dominate cold starts clear
+    1 s on every backend. Safe to call repeatedly; changing the directory
+    resets jax's cache singleton so the new location takes effect.
+    """
+    d = cache_dir or COMPILE_CACHE_DIR
+    os.makedirs(d, exist_ok=True)
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_time_secs)
+    # jax initializes its cache singleton AT MOST ONCE per process, bound
+    # to whatever dir was configured at the first compile. Any jit that
+    # ran before this call (CLI/import-time helpers) trips that latch
+    # with no dir and silently disables caching for the rest of the
+    # process — every "writing cache entry" after that is a no-op. Drop
+    # the singleton whenever it isn't already live against `d` so the
+    # next compile re-initializes with the configured dir.
+    from jax._src import compilation_cache as _cc
+
+    if prev != d or getattr(_cc, "_cache", None) is None:
+        _cc.reset_cache()
+    install_cache_counters()
+    return d
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache off, including when a previous caller in
+    this process enabled it (bench's _import_compute and the CPU test
+    mesh enable unconditionally): unset the dir and drop jax's cache
+    singleton so no further entries are read or written — the documented
+    escape hatch for the jaxlib 0.4.37 cache-writer crash."""
+    from jax._src import compilation_cache as _cc
+
+    if jax.config.jax_compilation_cache_dir is not None:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
+
+
+def enable_for_config(cfg: ExperimentConfig) -> str | None:
+    """Apply cfg.train.compile_cache / compile_cache_dir (Trainer entry).
+
+    None = auto: on for accelerator backends, off on cpu — cross-process
+    cache *reads* on this host's cpu jaxlib intermittently corrupt the
+    heap (config.py compile_cache comment has the bisect evidence);
+    writes are safe but pointless if nothing will read them.
+    """
+    if cfg.train.compile_cache is False:  # explicit off: tear down
+        disable_compile_cache()
+        return None
+    if cfg.train.compile_cache is None and jax.default_backend() == "cpu":
+        # auto-off: don't ENABLE, but leave ambient state alone — the
+        # test suite's process-wide cache (hostmesh.force_cpu_devices)
+        # must survive a default-config Trainer construction
+        return None
+    return enable_compile_cache(cfg.train.compile_cache_dir or None)
+
+
+def _sds(tree: Any) -> Any:
+    """Pytree of host arrays -> matching ShapeDtypeStructs."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype), tree)
+
+
+def example_train_batch(cfg: ExperimentConfig, dataset) -> dict:
+    """One host batch assembled exactly as Trainer.fit()'s producer does
+    (sample -> optional augment -> K-stack), so the lowered avals — and
+    therefore the compile-cache key — match the real first step.
+    `test_warmup_then_trainer_compiles_nothing` pins this equivalence.
+    """
+    rng = np.random.RandomState(0)
+    k = max(cfg.train.steps_per_call, 1)
+
+    def one() -> dict:
+        b = dataset.sample_train(cfg.data.batch_size, rng=rng)
+        if cfg.data.augment_geo or cfg.data.augment_photo:
+            from ..data.augmentation import make_augment_fn
+
+            b = make_augment_fn(cfg.data)(b, np.int64(0))
+        return {key: np.asarray(v) for key, v in b.items()}
+
+    b = one()
+    if k == 1:
+        return b
+    return {key: np.stack([v] * k) for key, v in b.items()}
+
+
+def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
+                   include_eval: bool = True) -> dict:
+    """AOT-compile the train (and optionally eval) executables for `cfg`.
+
+    Pure ahead-of-time: state and batch enter as ShapeDtypeStructs
+    (`jit(...).lower(specs).compile()`), so nothing executes on the
+    device and no batch bytes move — only XLA runs, and its output lands
+    in the persistent cache for every later process to load. Returns
+    compile timings plus the cache hit/miss delta of this call: a warm
+    cache shows misses == 0.
+    """
+    import jax.numpy as jnp
+
+    from ..data import build_dataset
+    from ..models.registry import build_model
+    from ..parallel.mesh import build_mesh
+    from .state import create_train_state, make_optimizer
+    from .step import make_eval_fn, make_train_step
+
+    enable_for_config(cfg)
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+    dataset = dataset if dataset is not None else build_dataset(cfg.data)
+
+    t = cfg.data.time_step
+    dtype = (jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16"
+             else jnp.float32)
+    model = build_model(cfg.model, flow_channels=2 * (t - 1), dtype=dtype,
+                        width_mult=cfg.width_mult,
+                        corr_max_disp=cfg.corr_max_disp,
+                        corr_stride=cfg.corr_stride)
+    from .schedule import step_decay_schedule
+
+    steps_per_epoch = max(dataset.num_train // cfg.data.batch_size, 1)
+    tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim,
+                                                       steps_per_epoch))
+    h, w = cfg.data.crop_size or cfg.data.image_size
+    channels = 3 if cfg.model == "ucf101_spatial" else 3 * t
+    example = jax.ShapeDtypeStruct((cfg.data.batch_size, h, w, channels),
+                                   jnp.float32)
+    # abstract state: eval_shape traces create_train_state without
+    # allocating params or touching the backend
+    state_sds = jax.eval_shape(
+        lambda x: create_train_state(model, x, tx, seed=cfg.train.seed),
+        example)
+
+    smooth_border = cfg.model in ("st_single", "st_baseline")
+    step = make_train_step(model, cfg, dataset.mean, mesh, smooth_border)
+    batch_sds = _sds(example_train_batch(cfg, dataset))
+
+    out: dict[str, Any] = {"model": cfg.model,
+                           "steps_per_call": max(cfg.train.steps_per_call, 1),
+                           "backend": jax.default_backend(),
+                           "cache_dir": jax.config.jax_compilation_cache_dir}
+    with cache_delta() as d:
+        t0 = time.perf_counter()
+        step.lower(state_sds, batch_sds).compile()
+        out["train_compile_s"] = round(time.perf_counter() - t0, 3)
+
+        if include_eval:
+            # mirror Trainer.__init__'s eval_batch_size shard rounding so
+            # the eval executable's avals match the real eval sweep
+            shards = mesh.shape["data"]
+            eval_bs = max(cfg.train.eval_batch_size // shards, 1) * shards
+            eval_fn = make_eval_fn(model, cfg, dataset.mean, mesh=mesh,
+                                   smooth_border_mask=smooth_border)
+            eval_sds = _sds({key: np.asarray(v)
+                             for key, v in dataset.sample_val(eval_bs, 0).items()})
+            t0 = time.perf_counter()
+            eval_fn.lower(state_sds.params, eval_sds).compile()
+            out["eval_compile_s"] = round(time.perf_counter() - t0, 3)
+    out["cache"] = d.stats()
+    return out
