@@ -33,6 +33,10 @@ import time
 METRIC = "flyingchairs_train_pairs_per_sec_per_chip"
 UNIT = "image-pairs/sec/chip"
 
+# --data mode: host input-pipeline throughput in isolation (no TPU).
+DATA_METRIC = "host_pipeline_batches_per_sec"
+DATA_UNIT = "batches/s"
+
 
 def emit(value: float, vs_baseline: float, error: str | None = None,
          **extra) -> None:
@@ -248,7 +252,9 @@ def orchestrate(deadline_s: float | None = None) -> None:
 _EXTRA_KEYS = ("matmul_tflops", "rtt_ms", "batch", "warp_impl",
                "steps_per_call", "model_tflops", "mfu_nominal",
                "mfu_vs_matmul", "compile_cache_requests",
-               "compile_cache_hits", "compile_cache_misses")
+               "compile_cache_hits", "compile_cache_misses",
+               "decode_cache_hits", "decode_cache_misses",
+               "decode_cache_evictions")
 
 
 def _save_last_good(res: dict) -> None:
@@ -510,6 +516,15 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
         res["compile_cache_requests"] = cache_d["requests"]
         res["compile_cache_hits"] = cache_d["hits"]
         res["compile_cache_misses"] = cache_d["misses"]
+    # Decoded-image cache counters (alongside the compile-cache ones):
+    # zeros for the synthetic headline workload, live for CLI benches of
+    # disk datasets — the host-decode half of the observability story.
+    dcache = getattr(ds, "cache_stats", None)
+    if dcache is not None:
+        dstats = dcache()
+        res["decode_cache_hits"] = int(dstats["hits"])
+        res["decode_cache_misses"] = int(dstats["misses"])
+        res["decode_cache_evictions"] = int(dstats["evictions"])
     # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
     # nominal chip peak and the concurrently measured matmul rate (the
     # latter cancels tunnel-condition swings — DESIGN.md).
@@ -542,6 +557,140 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
     return res
 
 
+def data_bench(num_workers: int = 0, batch: int = 8, image_size=(64, 64),
+               batches: int = 32, dataset: str = "synthetic",
+               data_path: str = "", seed: int = 0) -> dict:
+    """Host input-pipeline throughput in ISOLATION (batches/s, MB/s):
+    dataset decode/assembly through `data/pipeline.py`'s worker pool,
+    no model, no train step — so host vs. device bottlenecks are
+    attributable without a TPU. Forces the cpu backend (JAX_PLATFORMS)
+    before any compute import: a data measurement must never wait on,
+    or perturb, the shared accelerator tunnel.
+
+    Returns one flat JSON-ready dict: the throughput numbers plus the
+    pipeline's observability counters (assemble time, queue depth,
+    waits, worker utilization) and the decoded-image cache's
+    hit/miss/eviction counters — the schema the tier-1 smoke test pins.
+
+    The cpu pin is unconditional (an inherited JAX_PLATFORMS=tpu must
+    not defeat it) but scoped: the prior value is restored on return so
+    a process that later re-execs the TPU bench (orchestrate()) does not
+    leak cpu into its children. In-process caveat: if jax was already
+    imported with another platform before this call, the env var is too
+    late — the `bench.py --data` CLI path imports compute only after
+    this line.
+    """
+    prev_platforms = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        return _data_bench(num_workers, batch, image_size, batches,
+                           dataset, data_path, seed)
+    finally:
+        if prev_platforms is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_platforms
+
+
+def _data_bench(num_workers, batch, image_size, batches, dataset,
+                data_path, seed) -> dict:
+    import numpy as np  # noqa: F811 - the compute-import convention here
+
+    from deepof_tpu.core.config import DataConfig
+    from deepof_tpu.data.datasets import build_dataset
+    from deepof_tpu.data.pipeline import InputPipeline, derive_batch_rng
+
+    h, w = image_size
+    cfg = DataConfig(dataset=dataset, data_path=data_path,
+                     image_size=(h, w), gt_size=(h, w), batch_size=batch,
+                     num_workers=num_workers)
+    ds = build_dataset(cfg)
+
+    def assemble(i: int) -> dict:
+        return ds.sample_train(batch, rng=derive_batch_rng(seed, i))
+
+    pipe = InputPipeline(assemble, num_workers=num_workers,
+                         reorder_depth=cfg.reorder_depth)
+    try:
+        first = pipe.get()  # warm: worker spin-up, first-touch caches
+        bytes_per_batch = sum(
+            v.nbytes for v in first.values() if hasattr(v, "nbytes"))
+        t0 = time.perf_counter()
+        n_bytes = 0
+        for _ in range(batches):
+            b = pipe.get()
+            n_bytes += sum(v.nbytes for v in b.values()
+                           if hasattr(v, "nbytes"))
+        dt = max(time.perf_counter() - t0, 1e-9)
+        stats = pipe.stats()
+    finally:
+        pipe.close()
+    cache = (ds.cache_stats() if hasattr(ds, "cache_stats")
+             else {"hits": 0, "misses": 0, "evictions": 0})
+    bps = batches / dt
+    res = {
+        "metric": DATA_METRIC,
+        "value": round(bps, 2),
+        "unit": DATA_UNIT,
+        "mb_per_sec": round(n_bytes / dt / 2**20, 2),
+        "bytes_per_batch": int(bytes_per_batch),
+        "batches": batches,
+        "batch": batch,
+        "image_size": [int(h), int(w)],
+        "dataset": dataset,
+        "num_workers": stats["num_workers"],
+        "assemble_s_mean": stats["assemble_s_mean"],
+        "queue_depth": stats["queue_depth"],
+        "max_queue_depth": stats["max_queue_depth"],
+        "waits": stats["waits"],
+        "wait_s": stats["wait_s"],
+        "worker_util": stats["worker_util"],
+        "decode_cache_hits": int(cache["hits"]),
+        "decode_cache_misses": int(cache["misses"]),
+        "decode_cache_evictions": int(cache["evictions"]),
+    }
+    assert np.isfinite(bps)
+    return res
+
+
+def parse_image_size(spec: str) -> tuple[int, int]:
+    """'HxW' -> (H, W); the one parser shared by `bench.py --data` and
+    the package CLI's `bench --data-only` so the two advertised forms of
+    the measurement can't drift."""
+    try:
+        h, w = (int(x) for x in spec.lower().split("x"))
+    except ValueError:
+        raise SystemExit(f"bad --image-size {spec!r}: use HxW")
+    return h, w
+
+
+def data_main(argv: list[str]) -> int:
+    """`bench.py --data [--workers N] [--batch B] [--batches N]
+    [--image-size HxW] [--dataset NAME] [--data-path P]`: print the
+    data-only measurement as one JSON line. Plain return codes (no
+    os._exit): there is no tunnel to defuse on the cpu-only path."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="bench.py --data")
+    p.add_argument("--workers", type=int, default=0)
+    # batch default matches the headline config AND the package CLI's
+    # `deepof_tpu bench --data-only`, so the two advertised forms of
+    # this measurement are comparable out of the box
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--batches", type=int, default=32)
+    p.add_argument("--image-size", default="64x64",
+                   metavar="HxW")
+    p.add_argument("--dataset", default="synthetic")
+    p.add_argument("--data-path", default="")
+    args = p.parse_args([a for a in argv if a != "--data"])
+    h, w = parse_image_size(args.image_size)
+    res = data_bench(num_workers=args.workers, batch=args.batch,
+                     image_size=(h, w), batches=args.batches,
+                     dataset=args.dataset, data_path=args.data_path)
+    print(json.dumps(res), flush=True)
+    return 0
+
+
 def main(deadline_s: float | None = None) -> None:
     """Child mode: run the bench under a wall-clock watchdog. The init
     watchdog alone is not enough: a wedged relay can also hang the
@@ -562,7 +711,9 @@ def main(deadline_s: float | None = None) -> None:
 
 
 if __name__ == "__main__":
-    if "--run" in sys.argv:
+    if "--data" in sys.argv:
+        sys.exit(data_main(sys.argv[1:]))
+    elif "--run" in sys.argv:
         main()
     else:
         orchestrate()

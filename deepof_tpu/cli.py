@@ -137,6 +137,23 @@ def main(argv=None) -> int:
     p_bench.add_argument("--model", default="inception_v3")
     p_bench.add_argument("--batch", type=int, default=16)
     p_bench.add_argument("--steps", type=int, default=20)
+    p_bench.add_argument("--data-only", action="store_true",
+                         help="measure host input-pipeline throughput in "
+                              "isolation (batches/s, MB/s; cpu-only, no "
+                              "accelerator touched) instead of the train "
+                              "step — attributes host vs. device "
+                              "bottlenecks without a TPU")
+    p_bench.add_argument("--workers", type=int, default=0,
+                         help="data-only mode: pipeline worker threads")
+    p_bench.add_argument("--batches", type=int, default=32,
+                         help="data-only mode: batches to time")
+    p_bench.add_argument("--image-size", default="64x64", metavar="HxW",
+                         help="data-only mode: decoded image size")
+    p_bench.add_argument("--dataset", default="synthetic",
+                         help="data-only mode: dataset to assemble "
+                              "(flyingchairs/sintel/ucf101/synthetic)")
+    p_bench.add_argument("--data-path", default="",
+                         help="data-only mode: dataset root on disk")
 
     p_an = sub.add_parser("analyze", help="summarize a run's metrics log")
     p_an.add_argument("--log-dir", required=True)
@@ -162,8 +179,17 @@ def main(argv=None) -> int:
             sys.path.insert(0, repo_root)
         import bench as bench_mod
 
-        res = bench_mod.bench(model_name=args.model, batch=args.batch,
-                              steps=args.steps)
+        if args.data_only:
+            h, w = bench_mod.parse_image_size(args.image_size)
+            res = bench_mod.data_bench(num_workers=args.workers,
+                                       batch=args.batch,
+                                       batches=args.batches,
+                                       image_size=(h, w),
+                                       dataset=args.dataset,
+                                       data_path=args.data_path)
+        else:
+            res = bench_mod.bench(model_name=args.model, batch=args.batch,
+                                  steps=args.steps)
         print(json.dumps(res))
         return 0
 

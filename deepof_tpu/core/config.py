@@ -135,6 +135,23 @@ class DataConfig:
     augment_photo: bool = False
     crop_size: tuple[int, int] | None = None
     prefetch: int = 2
+    # Host input-pipeline worker threads (data/pipeline.py): N workers
+    # decode/resize/augment/stack (super-)batches out-of-order and
+    # deliver them in order through a bounded reorder buffer, with
+    # deterministic per-batch seeding — the delivered stream is
+    # bit-identical for any worker count. 0 = assemble inline on the
+    # prefetch thread (the legacy single-thread path, zero overhead).
+    # cv2 and the native C++ IO release the GIL, so decode parallelism
+    # is real; size to the host cores left over after the runtime.
+    num_workers: int = 0
+    # Reorder-buffer bound: how many batches workers may run ahead of
+    # delivery (caps buffered-batch memory when one slow batch holds
+    # back the cursor). 0 = auto (2 x num_workers). NOTE: with
+    # on-device augmentation (augment_geo/augment_photo) the buffered
+    # batches are DEVICE arrays, so this bound spends HBM, not host
+    # RAM — at large batch x steps_per_call, size it (and num_workers)
+    # against the chip's memory headroom.
+    reorder_depth: int = 0
     cache_decoded: bool = True
     # byte budget of the decoded-image LRU (host RAM). The cache stores
     # NATIVE-resolution decoded images (resize happens per batch), so the

@@ -4,8 +4,9 @@ Loaders re-implement the reference's dataset semantics (SURVEY.md §2.5) —
 FlyingChairs ppm/flo pairs with the official split file, Sintel T-frame
 sliding-window volumes, UCF-101 class-balanced pair sampling — plus a
 synthetic dataset for tests/benchmarks, behind one `Dataset` protocol, with
-an async double-buffered prefetcher replacing the reference's synchronous
-per-step cv2 reads (`sintelTrain.py:190`).
+a multi-worker batch-assembly pipeline with deterministic ordering
+(`pipeline.py`), and an async double-buffered prefetcher replacing the
+reference's synchronous per-step cv2 reads (`sintelTrain.py:190`).
 """
 
 from .augmentation import (
@@ -24,6 +25,7 @@ from .datasets import (
     UCF101Data,
     build_dataset,
 )
+from .pipeline import InputPipeline, derive_batch_rng
 from .prefetch import Prefetcher
 
 __all__ = [
@@ -39,5 +41,7 @@ __all__ = [
     "SyntheticData",
     "UCF101Data",
     "build_dataset",
+    "InputPipeline",
+    "derive_batch_rng",
     "Prefetcher",
 ]

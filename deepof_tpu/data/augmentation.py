@@ -152,6 +152,13 @@ def make_augment_fn(cfg: DataConfig):
     Image tensors stay on device — the downstream `device_put` with the
     batch sharding reshards them device-to-device instead of forcing a
     device->host->device roundtrip on the hot input path.
+
+    Thread contract: the train loop calls this from input-pipeline
+    WORKER threads (`data/pipeline.py`), concurrently at num_workers>1.
+    That is safe — jax jit dispatch is thread-safe and the fn holds no
+    state — and deterministic: the seed is drawn from the caller's
+    per-batch derived rng, so a batch's augmentation never depends on
+    which worker ran it.
     """
     geo, photo = cfg.augment_geo, cfg.augment_photo
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import os
 import re
+import threading
 import warnings
 from typing import Protocol
 
@@ -96,11 +97,21 @@ class Dataset(Protocol):
 
     def sample_val(self, batch_size: int, batch_id: int) -> dict: ...
 
+    def cache_stats(self) -> dict: ...
+
 
 class _DecodedCache:
     """Byte-bounded decoded-image cache (SURVEY.md §7.3.4: per-step host
     decode starves a TPU). LRU eviction keeps host RAM bounded even on the
-    full 22k-pair FlyingChairs set."""
+    full 22k-pair FlyingChairs set.
+
+    Thread-safe: the multi-worker input pipeline (`data/pipeline.py`)
+    shares one cache across decode workers. The OrderedDict is guarded by
+    a lock; misses decode OUTSIDE it so workers never serialize on cv2 —
+    two threads missing the same path decode it twice (benign: identical
+    result, last insert wins, double-counted bytes corrected on insert).
+    Hit/miss/eviction counters surface in train logs and `bench.py`.
+    """
 
     def __init__(self, enabled: bool, reader, max_bytes: int = 4 << 30):
         self._enabled = enabled
@@ -109,19 +120,38 @@ class _DecodedCache:
         self._bytes = 0
         self._store: collections.OrderedDict[str, np.ndarray] = (
             collections.OrderedDict())
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
 
     def __call__(self, path: str) -> np.ndarray:
         if not self._enabled:
             return self._reader(path)
-        hit = self._store.pop(path, None)
-        if hit is None:
-            hit = self._reader(path)
-            self._bytes += hit.nbytes
+        with self._lock:
+            hit = self._store.pop(path, None)
+            if hit is not None:
+                self._hits += 1
+                self._store[path] = hit  # re-insert as most recent
+                return hit
+            self._misses += 1
+        decoded = self._reader(path)  # off-lock: decode is the slow part
+        with self._lock:
+            prev = self._store.pop(path, None)  # racing double-decode
+            if prev is None:
+                self._bytes += decoded.nbytes
             while self._bytes > self._max_bytes and self._store:
                 _, old = self._store.popitem(last=False)
                 self._bytes -= old.nbytes
-        self._store[path] = hit  # (re-)insert as most recent
-        return hit
+                self._evictions += 1
+            self._store[path] = decoded
+        return decoded
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "evictions": self._evictions, "bytes": self._bytes,
+                    "entries": len(self._store)}
 
 
 class FlyingChairsData:
@@ -213,8 +243,17 @@ class FlyingChairsData:
 
     def sample_train(self, batch_size, iteration=None, rng=None):
         if iteration is not None:  # sequential, gen-2
-            start = (iteration * batch_size) % max(self.num_train - batch_size + 1, 1)
-            sids = self.train_ids[start : start + batch_size]
+            # wrap like sample_val: a num_train below batch_size (or a
+            # start near the tail) must still yield exactly batch_size
+            # samples — a short batch breaks the compiled executable's
+            # fixed shapes
+            if not self.num_train:
+                raise ValueError(
+                    f"empty FlyingChairs train split under {self._root} "
+                    "(split file marks every pair as val)")
+            start = (iteration * batch_size) % self.num_train
+            sids = [self.train_ids[(start + k) % self.num_train]
+                    for k in range(batch_size)]
         else:
             rng = rng or np.random
             sids = [self.train_ids[i] for i in rng.randint(0, self.num_train, batch_size)]
@@ -224,6 +263,9 @@ class FlyingChairsData:
         start = (batch_id * batch_size) % max(self.num_val, 1)
         sids = [self.val_ids[(start + k) % self.num_val] for k in range(batch_size)]
         return self._batch(sids)
+
+    def cache_stats(self) -> dict:
+        return self._cache.stats()
 
 
 class SintelData:
@@ -369,7 +411,12 @@ class SintelData:
         return {"volume": vols, "flow": flows}
 
     def sample_train(self, batch_size, iteration=None, rng=None):
-        rng = rng or np.random.RandomState()
+        # no frame-sequential gen-2 mode exists for windows; a
+        # sequential (`iteration`) caller still gets a DETERMINISTIC
+        # exact-batch_size draw per iteration instead of a silently
+        # unseeded one (same contract as the other dataset classes)
+        if rng is None:
+            rng = np.random.RandomState(iteration)  # None = OS entropy
         idxs = [self.train_idx[i] for i in rng.randint(0, self.num_train, batch_size)]
         return self._batch(idxs, crop_rng=rng)
 
@@ -377,6 +424,9 @@ class SintelData:
         start = (batch_id * batch_size) % max(self.num_val, 1)
         idxs = [self.val_idx[(start + k) % self.num_val] for k in range(batch_size)]
         return self._batch(idxs)
+
+    def cache_stats(self) -> dict:
+        return self._cache.stats()
 
 
 class UCF101Data:
@@ -453,7 +503,10 @@ class UCF101Data:
         ]).astype(np.float32)
 
     def sample_train(self, batch_size, iteration=None, rng=None):
-        rng = rng or np.random.RandomState()
+        # sequential callers: deterministic per-iteration draw (see
+        # SintelData.sample_train)
+        if rng is None:
+            rng = np.random.RandomState(iteration)  # None = OS entropy
         avail = list(self.train_clips)
         replace = batch_size > len(avail)
         class_ids = rng.choice(avail, size=batch_size, replace=replace)
@@ -466,6 +519,9 @@ class UCF101Data:
         avail = sorted(self.val_clips)
         ci = avail[batch_id % len(avail)]
         return self._batch_from(self.val_clips, [ci] * batch_size, rng)
+
+    def cache_stats(self) -> dict:
+        return self._cache.stats()
 
 
 class SyntheticData:
@@ -644,6 +700,12 @@ class SyntheticData:
         seeds = [self.num_train + (batch_id * batch_size + k) % self.num_val
                  for k in range(batch_size)]
         return self._batch(seeds)
+
+    def cache_stats(self) -> dict:
+        """Procedural data decodes nothing; a zeroed record keeps the
+        observability schema uniform across datasets."""
+        return {"hits": 0, "misses": 0, "evictions": 0, "bytes": 0,
+                "entries": 0}
 
 
 def build_dataset(cfg: DataConfig) -> Dataset:

@@ -51,6 +51,7 @@ class Prefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._stop = threading.Event()
         self._exc: BaseException | None = None
+        self._max_depth = 0  # peak staged-batch count (GIL-atomic update)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -77,6 +78,8 @@ class Prefetcher:
                 while not self._stop.is_set():
                     try:
                         self._q.put(batch, timeout=0.1)
+                        self._max_depth = max(self._max_depth,
+                                              self._q.qsize())
                         break
                     except queue.Full:
                         continue
@@ -96,6 +99,14 @@ class Prefetcher:
     def __iter__(self) -> Iterator[dict]:
         while True:
             yield self.get()
+
+    def stats(self) -> dict:
+        """Staging-queue observability: current and peak staged depth.
+        A persistently empty staging queue while the device consumes
+        points the bottleneck at the producer side (the InputPipeline's
+        own stats say whether assembly or staging is the cause)."""
+        return {"staged_depth": self._q.qsize(),
+                "max_staged_depth": self._max_depth}
 
     def close(self) -> None:
         self._stop.set()

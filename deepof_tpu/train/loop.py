@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.config import ExperimentConfig
-from ..data import Prefetcher, build_dataset
+from ..data import InputPipeline, Prefetcher, build_dataset, derive_batch_rng
 from ..models.registry import build_model
 from ..parallel.mesh import batch_sharding, build_mesh
 from .checkpoint import CheckpointManager
@@ -50,6 +50,10 @@ from .warmup import cache_delta, enable_for_config
 # re-raises, so a run wedged in compile stays killable.
 _EARLY_SIGTERM: dict = {"sig": None, "handler": None}
 
+# A prefetch.get() wait above this is counted as a `starved` step (the
+# device had no staged batch to eat); below it is queue-handoff noise.
+STARVED_WAIT_S = 1e-3
+
 
 def install_preemption_latch() -> None:
     def _latch(signum, frame):
@@ -70,19 +74,28 @@ def install_preemption_latch() -> None:
         pass
 
 
-def data_stream_rng(mesh, seed: int, start_step: int) -> np.random.RandomState:
-    """Host data-sampling stream for a fit() beginning at start_step.
+def data_stream_seed(mesh, seed: int, start_step: int) -> np.ndarray:
+    """Base seed of the host data-sampling stream for a fit() beginning
+    at start_step.
 
-    Seeded by (process_seed, start_step): process_seed decorrelates data
-    shards while keeping replica peers identical (parallel/mesh.py);
-    start_step gives each RESUME a fresh stream — a fixed seed would
-    replay the draws already trained on, since the numpy data rng is not
-    part of the checkpoint. Array seeding is exact and order-sensitive.
+    (process_seed, start_step): process_seed decorrelates data shards
+    while keeping replica peers identical (parallel/mesh.py); start_step
+    gives each RESUME a fresh stream — a fixed seed would replay the
+    draws already trained on, since the numpy data rng is not part of
+    the checkpoint. The loop derives one rng PER BATCH INDEX from this
+    base (`data/pipeline.py::derive_batch_rng`), so the sample/augment
+    stream is bit-identical for any `data.num_workers`.
     """
     from ..parallel.mesh import process_seed
 
-    return np.random.RandomState(np.array(
-        [process_seed(mesh, seed), start_step], dtype=np.uint32))
+    return np.array([process_seed(mesh, seed), start_step], dtype=np.uint32)
+
+
+def data_stream_rng(mesh, seed: int, start_step: int) -> np.random.RandomState:
+    """Sequential-stream view of `data_stream_seed` (tools that sample
+    without the batch-indexed pipeline, e.g. tools/synthetic_fit.py).
+    Array seeding is exact and order-sensitive."""
+    return np.random.RandomState(data_stream_seed(mesh, seed, start_step))
 
 
 def _example_input(cfg: ExperimentConfig) -> jnp.ndarray:
@@ -263,7 +276,7 @@ class Trainer:
         cfg = self.cfg
         self.enable_augmentation()
         start_step = int(self.state.step)
-        rng = data_stream_rng(self.mesh, cfg.train.seed, start_step)
+        seed_arr = data_stream_seed(self.mesh, cfg.train.seed, start_step)
         k = max(cfg.train.steps_per_call, 1)
         if k == 1:
             sharding = batch_sharding(self.mesh)
@@ -271,7 +284,6 @@ class Trainer:
             from ..parallel.mesh import stacked_batch_sharding
 
             sharding = stacked_batch_sharding(self.mesh)
-        it_holder = {"i": 0}
 
         def _stack(xs):
             # On-device augmentation output stays on device (D2D stack);
@@ -283,33 +295,58 @@ class Trainer:
                 return jnp.stack(xs)
             return np.stack([np.asarray(x) for x in xs])
 
-        def produce():
+        def assemble(call_idx: int) -> dict:
+            """One dispatch's input, a pure function of its index: each
+            micro-batch i draws from derive_batch_rng(seed_arr, i), so
+            the stream is identical for any num_workers AND any
+            steps_per_call regrouping. Runs on pipeline workers (or
+            inline on the prefetch thread at num_workers=0) — decode,
+            augmentation, and the K-stack all happen off the main
+            thread. A NaN rollback resumes dispatching from the next
+            unconsumed index (the stream continues forward, exactly like
+            the pre-pipeline sequential rng did)."""
             if k == 1:
-                b = self._next_train_batch(it_holder["i"], rng)
-                it_holder["i"] += 1
-                return b
+                return self._next_train_batch(
+                    call_idx, derive_batch_rng(seed_arr, call_idx))
             # steps_per_call: K batches stacked on a leading scan axis
-            bs = []
-            for _ in range(k):
-                bs.append(self._next_train_batch(it_holder["i"], rng))
-                it_holder["i"] += 1
+            bs = [
+                self._next_train_batch(i, derive_batch_rng(seed_arr, i))
+                for i in range(call_idx * k, call_idx * k + k)
+            ]
             return {key: _stack([b[key] for b in bs]) for key in bs[0]}
 
         timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
+        # Multi-worker host assembly (data/pipeline.py): N threads
+        # decode/augment/stack out-of-order, delivery stays in index
+        # order through the bounded reorder buffer.
+        pipeline = InputPipeline(assemble, num_workers=cfg.data.num_workers,
+                                 reorder_depth=cfg.data.reorder_depth)
         # stage=True: the next (super-)batch is transferred AND resident
         # on device while the current call's scan executes, its wait spent
-        # on the prefetch thread and accounted as the `put` phase.
-        prefetch = Prefetcher(produce, depth=cfg.data.prefetch,
-                              sharding=sharding, stage=True,
-                              phase_cb=timer.phase)
+        # on the prefetch thread and accounted as the `put` phase. The
+        # pipeline's workers start assembling eagerly at construction, so
+        # a failure before the main try/finally takes ownership must not
+        # leak the live pool.
+        try:
+            prefetch = Prefetcher(pipeline.get, depth=cfg.data.prefetch,
+                                  sharding=sharding, stage=True,
+                                  phase_cb=timer.phase)
+        except BaseException:
+            pipeline.close()
+            raise
         # In-flight metrics pipelining (DESIGN.md "Execution layer"):
         # depth > 0 drains value fetches on a background consumer so the
         # next dispatch never waits on the previous fetch's RTT; the
         # bounded queue blocks dispatch at `depth` in-flight calls,
         # keeping host progress honest. depth 0 = serial fetch inline.
         depth = max(cfg.train.pipeline_depth, 0)
-        fetcher = (AsyncFetcher(depth=depth, timer=timer) if depth > 0
-                   else SyncFetcher(timer=timer))
+        try:
+            fetcher = (AsyncFetcher(depth=depth, timer=timer) if depth > 0
+                       else SyncFetcher(timer=timer))
+        except BaseException:  # same leak guard as the Prefetcher above
+            pipeline.close()
+            prefetch.close()
+            raise
         # Set by the fetch callback when a fetched step is non-finite;
         # the main loop converts it into a rollback at the next boundary
         # (at most `depth` extra dispatched calls late — all discarded by
@@ -385,6 +422,16 @@ class Trainer:
                     return  # never log a diverged record
                 streak["ok"] = True
                 if log_due_:
+                    # input-side observability travels with every train
+                    # record: pipeline queue/assemble/utilization stats,
+                    # the loop's starved counter, and the decoded-image
+                    # cache's hit/miss/eviction counters (alongside the
+                    # compile-cache counters in the first-step record)
+                    cache_s = getattr(self.dataset, "cache_stats", None)
+                    cache_kw = ({f"decode_cache_{ck}": cv
+                                 for ck, cv in cache_s().items()
+                                 if ck in ("hits", "misses", "evictions")}
+                                if cache_s is not None else {})
                     self.logger.log(
                         "train", gs, epoch=ep,
                         loss=_scalar_last(m_host["total"]),
@@ -392,7 +439,13 @@ class Trainer:
                         grad_norm=_scalar_last(m_host["grad_norm"]),
                         **{key: _scalar_last(v) for key, v in m_host.items()
                            if key in ("action_loss", "accuracy")},
-                        **timer.rates(), **timer.phases())
+                        **timer.rates(), **timer.phases(),
+                        **timer.counters(),
+                        **{f"data_{dk}": dv
+                           for dk, dv in pipeline.stats().items()},
+                        **{f"data_{dk}": dv
+                           for dk, dv in prefetch.stats().items()},
+                        **cache_kw)
 
             gstep = start_step
             consecutive_nans = 0
@@ -400,7 +453,13 @@ class Trainer:
             while gstep < total_steps and stop_sig["sig"] is None:
                 t0 = time.perf_counter()
                 batch = prefetch.get()
-                timer.phase("assemble", time.perf_counter() - t0)
+                wait = time.perf_counter() - t0
+                timer.phase("assemble", wait)
+                if wait > STARVED_WAIT_S:
+                    # the device-facing starvation signal: the main
+                    # thread (and so the next dispatch) measurably
+                    # waited on the host input side
+                    timer.count("starved")
                 t0 = time.perf_counter()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
                     cache_watch = cache_delta()
@@ -532,6 +591,13 @@ class Trainer:
                             "saving the diverged state")
         finally:
             fetcher.close()
+            # pipeline BEFORE prefetch: the prefetch thread may be
+            # blocked inside pipeline.get() waiting on workers, which
+            # the Prefetcher's own stop event cannot interrupt —
+            # closing the pipeline first releases it (its get() raise
+            # is swallowed into the dying prefetch thread), so
+            # prefetch.close()'s join returns promptly
+            pipeline.close()
             prefetch.close()
             self.ckpt.finalize()  # commit any in-flight async save
             # restore only AFTER finalize(): the final async-save commit
@@ -547,11 +613,16 @@ class Trainer:
                 if restore is None or restore is _EARLY_SIGTERM.get("handler"):
                     restore = signal.SIG_DFL
                 signal.signal(signal.SIGTERM, restore)
-        # phases + fetcher stats travel with the rates: bench logs show
-        # where host time went (assemble/put/dispatch/fetch) and how much
-        # overlap the pipelined drain actually achieved (max_in_flight).
+        # phases + fetcher + input-pipeline stats travel with the rates:
+        # bench logs show where host time went (assemble/put/dispatch/
+        # fetch), how much overlap the pipelined drain achieved
+        # (max_in_flight), and whether the device ever starved on host
+        # batch assembly (starved / data_* worker stats).
         return {**last_eval, **timer.rates(), **timer.phases(),
-                **{f"pipeline_{k}": v for k, v in fetcher.stats().items()}}
+                **timer.counters(),
+                **{f"pipeline_{k}": v for k, v in fetcher.stats().items()},
+                **{f"data_{k}": v for k, v in pipeline.stats().items()},
+                **{f"data_{k}": v for k, v in prefetch.stats().items()}}
 
     def _rollback(self, step: int) -> None:
         restored = self.ckpt.restore(self.state)

@@ -81,6 +81,11 @@ class StepTimer:
     recorded by the AsyncFetcher consumer). Under full overlap,
     fetch time stops appearing on the main thread's critical path while
     still being accounted here.
+
+    `count(name)` accumulates named event counters — the loop's
+    starvation instrument: `starved` counts steps where the main thread
+    measurably waited on the input side (the device had nothing to eat).
+    Counters travel with `counters()` into train logs and bench output.
     """
 
     def __init__(self, items_per_step: int, n_chips: int = 1):
@@ -91,6 +96,7 @@ class StepTimer:
         self._steps = 0
         self._phases: dict[str, float] = {}
         self._phase_counts: dict[str, int] = {}
+        self._counters: dict[str, int] = {}
 
     def phase(self, name: str, seconds: float) -> None:
         """Accumulate host seconds spent in a named loop phase. Called
@@ -98,6 +104,15 @@ class StepTimer:
         names per thread, so the GIL-atomic dict ops suffice."""
         self._phases[name] = self._phases.get(name, 0.0) + seconds
         self._phase_counts[name] = self._phase_counts.get(name, 0) + 1
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Accumulate a named event counter (e.g. `starved`)."""
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    def counters(self) -> dict[str, int]:
+        """Event-counter totals (snapshot-first, same rationale as
+        `phases()`)."""
+        return dict(self._counters)
 
     def phases(self) -> dict[str, float]:
         """Per-phase totals, `phase_<name>_s` keyed (log/bench-ready).
@@ -131,6 +146,7 @@ class StepTimer:
     def reset(self) -> None:
         self._last, self._elapsed, self._steps = None, 0.0, 0
         self._phases, self._phase_counts = {}, {}
+        self._counters = {}
 
     def mark(self) -> tuple[float, int]:
         """Snapshot for `rewind` — taken when a checkpoint is saved."""

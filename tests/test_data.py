@@ -57,6 +57,24 @@ def test_flyingchairs_split_and_shapes(chairs_root):
     assert v["source"].shape[0] == 2
 
 
+def test_flyingchairs_sequential_never_short_batches(tmp_path):
+    """Sequential (gen-2) sampling must wrap like sample_val: a short
+    batch (num_train < batch_size, or a start near the tail) breaks the
+    compiled executable's fixed shapes."""
+    _make_flyingchairs(tmp_path, n=5)  # markers: 2 train, 3 val
+    cfg = DataConfig(dataset="flyingchairs", data_path=str(tmp_path),
+                     image_size=(24, 40), gt_size=(32, 48), batch_size=4)
+    ds = FlyingChairsData(cfg)
+    assert ds.num_train == 2  # smaller than the batch
+    for it in range(3):
+        b = ds.sample_train(4, iteration=it)
+        assert b["source"].shape[0] == 4
+        assert b["flow"].shape[0] == 4
+    # wrap is deterministic per iteration
+    np.testing.assert_array_equal(ds.sample_train(4, iteration=1)["source"],
+                                  ds.sample_train(4, iteration=1)["source"])
+
+
 def test_flyingchairs_fallback_split(tmp_path):
     _make_flyingchairs(tmp_path, n=5)
     os.remove(tmp_path / "FlyingChairs_train_val.txt")
@@ -103,6 +121,33 @@ def test_sintel_windows_and_volume(tmp_path):
     assert b["flow"].shape == (2, 32, 64, 4)  # 2(T-1)
     v = ds.sample_val(2, 0)
     assert v["volume"].shape[-1] == 9
+
+
+def test_sintel_ucf_sequential_iteration_is_deterministic(tmp_path):
+    """Datasets without a true sequential mode must still honor the
+    `iteration` contract: a seeded, exact-batch_size draw per iteration
+    (not a silently unseeded one)."""
+    _make_sintel(tmp_path)
+    cfg = DataConfig(dataset="sintel", data_path=str(tmp_path),
+                     image_size=(32, 64), gt_size=(32, 64), time_step=2,
+                     sintel_pass="final")
+    ds = SintelData(cfg)
+    a = ds.sample_train(2, iteration=3)
+    b = ds.sample_train(2, iteration=3)
+    c = ds.sample_train(2, iteration=4)
+    np.testing.assert_array_equal(a["volume"], b["volume"])
+    assert a["volume"].shape[0] == 2
+    assert not np.array_equal(a["volume"], c["volume"])
+
+    ucf_root = tmp_path / "ucf"
+    _make_ucf101(ucf_root)
+    ucfg = DataConfig(dataset="ucf101", data_path=str(ucf_root),
+                      image_size=(24, 32), batch_size=2)
+    uds = UCF101Data(ucfg)
+    ua = uds.sample_train(2, iteration=3)
+    ub = uds.sample_train(2, iteration=3)
+    np.testing.assert_array_equal(ua["source"], ub["source"])
+    np.testing.assert_array_equal(ua["label"], ub["label"])
 
 
 def test_sintel_crop(tmp_path):

@@ -1,0 +1,365 @@
+"""Multi-worker host input pipeline (`data/pipeline.py`): deterministic
+ordering, bounded reorder buffer, concurrency, observability — and the
+ISSUE 2 acceptance pin: under an injected per-image decode delay, 4
+workers beat the single-thread path >= 2x end-to-end while delivering a
+bit-identical batch stream. Pure host-side mechanics plus one tiny jit
+step — fast tier (pattern of test_pipeline.py).
+"""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepof_tpu.core.config import DataConfig
+from deepof_tpu.data.datasets import SyntheticData, _DecodedCache
+from deepof_tpu.data.pipeline import InputPipeline, derive_batch_rng
+from deepof_tpu.data.prefetch import Prefetcher
+
+
+def _digest(batch: dict) -> str:
+    h = hashlib.sha1()
+    for k in sorted(batch):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(np.asarray(batch[k])).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------- seeding
+
+def test_derive_batch_rng_deterministic_and_distinct():
+    a = derive_batch_rng(7, 3).randint(0, 2**31, 8)
+    a2 = derive_batch_rng(7, 3).randint(0, 2**31, 8)
+    b = derive_batch_rng(7, 4).randint(0, 2**31, 8)
+    c = derive_batch_rng(8, 3).randint(0, 2**31, 8)
+    np.testing.assert_array_equal(a, a2)  # pure in (base, index)
+    assert not np.array_equal(a, b)  # index decorrelates
+    assert not np.array_equal(a, c)  # base decorrelates
+    # array base seeds (the loop's data_stream_seed) work and differ
+    d = derive_batch_rng(np.array([7, 0], np.uint32), 3).randint(0, 2**31, 8)
+    assert not np.array_equal(a, d)
+    # 64-bit indices AND base seeds fold in losslessly (no truncation)
+    derive_batch_rng(7, 2**40 + 5).randint(0, 10)
+    hi = derive_batch_rng(2**32, 3).randint(0, 2**31, 8)
+    lo = derive_batch_rng(0, 3).randint(0, 2**31, 8)
+    assert not np.array_equal(hi, lo)
+
+
+# ---------------------------------------------------- determinism contract
+
+def _stream_hashes(num_workers: int, n: int = 8) -> list[str]:
+    cfg = DataConfig(dataset="synthetic", image_size=(16, 16), batch_size=2)
+    ds = SyntheticData(cfg)
+
+    def assemble(i):
+        return ds.sample_train(2, rng=derive_batch_rng(11, i))
+
+    pipe = InputPipeline(assemble, num_workers=num_workers)
+    try:
+        return [_digest(pipe.get()) for _ in range(n)]
+    finally:
+        pipe.close()
+
+
+def test_stream_bit_identical_across_worker_counts():
+    """The contract: same config/seed => identical delivered stream for
+    num_workers in {0, 1, 4} (hashes of the first K batches)."""
+    h0 = _stream_hashes(0)
+    h1 = _stream_hashes(1)
+    h4 = _stream_hashes(4)
+    assert h0 == h1 == h4
+    assert len(set(h0)) == len(h0)  # and the batches genuinely differ
+
+
+# -------------------------------------------------------------- concurrency
+
+def test_workers_assemble_concurrently():
+    """Injected-blocking proof (no wall-clock): batches 0 and 1 rendezvous
+    at a 2-party barrier INSIDE make_batch — delivery can only complete if
+    two workers were inside assembly at the same time."""
+    barrier = threading.Barrier(2)
+    met = {"ok": False}
+
+    def make(i):
+        if i < 2:
+            barrier.wait(timeout=10.0)  # BrokenBarrierError on failure
+            met["ok"] = True
+        return {"i": np.asarray([i])}
+
+    pipe = InputPipeline(make, num_workers=4)
+    try:
+        out = [int(pipe.get()["i"][0]) for _ in range(6)]
+    finally:
+        pipe.close()
+    assert met["ok"]
+    assert out == list(range(6))  # concurrent assembly, ordered delivery
+
+
+def test_out_of_order_completion_delivers_in_order():
+    """Early indices finish LAST; the reorder buffer must still deliver
+    index order."""
+    release = [threading.Event() for _ in range(4)]
+
+    def make(i):
+        if i < 4:
+            release[i].wait(timeout=10.0)
+        return {"i": np.asarray([i])}
+
+    pipe = InputPipeline(make, num_workers=4)
+    try:
+        for ev in reversed(release):  # complete 3, 2, 1, 0
+            ev.set()
+            time.sleep(0.01)
+        out = [int(pipe.get()["i"][0]) for _ in range(6)]
+    finally:
+        pipe.close()
+    assert out == list(range(6))
+
+
+def test_reorder_depth_bounds_claims():
+    """Workers may never claim past next_out + reorder_depth: with the
+    cursor's batch held back, at most `depth` assemblies start."""
+    hold = threading.Event()
+    started = []
+    lock = threading.Lock()
+
+    def make(i):
+        with lock:
+            started.append(i)
+        if i == 0:
+            hold.wait(timeout=10.0)
+        return {"i": np.asarray([i])}
+
+    pipe = InputPipeline(make, num_workers=4, reorder_depth=2)
+    try:
+        time.sleep(0.2)  # give eager workers every chance to overrun
+        with lock:
+            overrun = sorted(started)
+        assert overrun == [0, 1]  # bound: claims < next_out(0) + depth(2)
+        hold.set()
+        out = [int(pipe.get()["i"][0]) for _ in range(4)]
+    finally:
+        pipe.close()
+    assert out == list(range(4))
+
+
+# ------------------------------------------------------------------ errors
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_pipeline_error_surfaces_on_get(num_workers):
+    def boom(i):
+        if i == 1:
+            raise ValueError("decode failed")
+        return {"i": np.asarray([i])}
+
+    pipe = InputPipeline(boom, num_workers=num_workers)
+    try:
+        assert int(pipe.get()["i"][0]) == 0
+        with pytest.raises(ValueError, match="decode failed"):
+            pipe.get()
+            pipe.get()  # workers=0 hits index 1 on the second call
+    finally:
+        pipe.close()
+
+
+def test_close_is_idempotent_and_unblocks():
+    pipe = InputPipeline(lambda i: {"i": np.asarray([i])}, num_workers=2)
+    pipe.get()
+    pipe.close()
+    pipe.close()
+
+
+# ----------------------------------------------------------- observability
+
+def test_stats_schema_and_counters():
+    def make(i):
+        return {"x": np.zeros(4, np.float32)}
+
+    pipe = InputPipeline(make, num_workers=2)
+    try:
+        for _ in range(5):
+            pipe.get()
+        s = pipe.stats()
+    finally:
+        pipe.close()
+    for key in ("num_workers", "batches", "assemble_s", "assemble_s_mean",
+                "queue_depth", "max_queue_depth", "waits", "wait_s",
+                "worker_util"):
+        assert key in s, key
+    assert s["num_workers"] == 2
+    assert s["batches"] >= 5
+    assert s["max_queue_depth"] >= 1
+    assert 0.0 <= s["worker_util"] <= 1.0
+
+
+def test_decoded_cache_thread_safe_and_counted():
+    """The shared decoded cache under worker-pool concurrency: counters
+    add up, LRU state stays consistent, eviction accounting is exact."""
+    decode_lock = threading.Lock()
+    decodes = {"n": 0}
+
+    def reader(path):
+        with decode_lock:
+            decodes["n"] += 1
+        return np.ones((4, 4, 3), np.uint8)
+
+    cache = _DecodedCache(True, reader, max_bytes=1 << 30)
+    paths = [f"p{i}" for i in range(8)]
+    n_threads, n_iter = 4, 200
+
+    def hammer(seed):
+        rs = np.random.RandomState(seed)
+        for _ in range(n_iter):
+            out = cache(paths[rs.randint(len(paths))])
+            assert out.shape == (4, 4, 3)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = cache.stats()
+    assert s["hits"] + s["misses"] == n_threads * n_iter
+    assert s["misses"] >= len(paths)  # every path missed at least once
+    assert s["misses"] == decodes["n"]  # one decode per counted miss
+    assert s["entries"] == len(paths)
+    assert s["evictions"] == 0
+
+    # eviction accounting: capacity for ~2 entries of 48 bytes
+    small = _DecodedCache(True, reader, max_bytes=100)
+    for p in ("a", "b", "c", "d"):
+        small(p)
+    s2 = small.stats()
+    assert s2["evictions"] == 2
+    assert s2["bytes"] <= 100
+
+
+# -------------------------------------------- ISSUE 2 acceptance criterion
+
+class _SlowDecodeSynthetic(SyntheticData):
+    """SyntheticData with an injected per-image decode delay (sleep-based:
+    parallelizes under the GIL even on a 1-core host, so the test measures
+    pipeline overlap, not machine core count). The delay is large relative
+    to the real per-sample CPU work (~1 ms at 16x16), so scheduler noise
+    cannot drown the signal."""
+
+    DELAY_S = 0.02
+
+    def _sample(self, seed, shift_bound=None):
+        time.sleep(self.DELAY_S)
+        return super()._sample(seed, shift_bound)
+
+
+def _train_run(num_workers: int, n_batches: int = 8):
+    """End-to-end synthetic training skeleton: pipeline -> prefetcher
+    (device staging) -> jit train step -> metric fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    batch_size = 4
+    cfg = DataConfig(dataset="synthetic", image_size=(16, 16),
+                     batch_size=batch_size, num_workers=num_workers)
+    ds = _SlowDecodeSynthetic(cfg, num_train=64)
+
+    def assemble(i):
+        return ds.sample_train(batch_size, rng=derive_batch_rng(5, i))
+
+    pipe = InputPipeline(assemble, num_workers=num_workers)
+    pf = Prefetcher(pipe.get, depth=2, stage=True)
+    try:
+        @jax.jit
+        def train_step(p, batch):
+            resid = batch["source"] / 255.0 - p[None]
+            return p + 1e-2 * resid.mean(0), (resid ** 2).mean()
+
+        params = jnp.zeros((16, 16, 3))
+        hashes = []
+        b = pf.get()  # warmup: compile outside the timed window
+        hashes.append(_digest(b))
+        params, loss = train_step(params, b)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            b = pf.get()
+            hashes.append(_digest(b))
+            params, loss = train_step(params, b)
+        final = float(loss)  # value fetch: the honest clock
+        wall = time.perf_counter() - t0
+        stats = pipe.stats()
+    finally:
+        pipe.close()
+        pf.close()
+    assert np.isfinite(final)
+    return wall, hashes, stats
+
+
+def test_multiworker_training_beats_single_thread_2x_and_matches():
+    """Acceptance: with an injected per-image decode delay, num_workers=4
+    end-to-end synthetic training throughput beats num_workers=0 by >= 2x,
+    and the delivered batch stream is bit-identical across worker counts.
+
+    Margins: per batch = 4 images x 20 ms = 80 ms serial assembly; over
+    the 8-batch timed window the single-thread path is assembly-gated at
+    ~1 batch/80 ms even after the prefetch queue's head start, while 4
+    workers sustain ~1 batch/20 ms and enter the window with the reorder
+    buffer primed — expected gap well past 3x, so >= 2x holds with slack
+    (the injected delay sleeps rather than burning CPU, so 1-core hosts
+    parallelize it). Determinism is asserted on EVERY attempt; the
+    wall-clock ratio gets one bounded retry — a single descheduling spike
+    on a saturated CI host must not fail the suite, two in a row is a
+    real regression."""
+    last = None
+    for _ in range(2):
+        wall0, h0, _ = _train_run(num_workers=0)
+        wall4, h4, stats4 = _train_run(num_workers=4)
+        assert h0 == h4  # bit-identical stream, every attempt
+        if wall0 / wall4 >= 2.0:
+            break
+        last = (wall0, wall4, stats4)
+    else:
+        pytest.fail("num_workers=4 not >= 2x over single-thread in two "
+                    f"attempts: wall0={last[0]:.3f}s wall4={last[1]:.3f}s "
+                    f"pipeline stats={last[2]}")
+
+
+# --------------------------------------------------- bench.py data mode
+
+def test_data_bench_schema_and_throughput():
+    """Tier-1 smoke for the data-only bench: runs on SyntheticData with a
+    worker pool and emits the throughput/counter schema — so the
+    observability surface can't silently rot."""
+    import json
+
+    import bench
+
+    res = bench.data_bench(num_workers=2, batch=2, image_size=(16, 16),
+                           batches=4)
+    json.dumps(res)  # one JSON line, by construction
+    for key in ("metric", "value", "unit", "mb_per_sec", "bytes_per_batch",
+                "batches", "batch", "image_size", "dataset", "num_workers",
+                "assemble_s_mean", "queue_depth", "max_queue_depth",
+                "waits", "wait_s", "worker_util", "decode_cache_hits",
+                "decode_cache_misses", "decode_cache_evictions"):
+        assert key in res, key
+    assert res["metric"] == bench.DATA_METRIC
+    assert res["unit"] == bench.DATA_UNIT
+    assert res["value"] > 0.0
+    assert res["mb_per_sec"] > 0.0
+    assert res["num_workers"] == 2
+    assert res["batches"] == 4
+
+
+def test_data_bench_deterministic_across_worker_counts():
+    """The bench path inherits the pipeline contract: worker count is a
+    throughput knob, never a stream change (value aside)."""
+    import bench
+
+    a = bench.data_bench(num_workers=0, batch=2, image_size=(16, 16),
+                         batches=3)
+    b = bench.data_bench(num_workers=3, batch=2, image_size=(16, 16),
+                         batches=3)
+    assert a["bytes_per_batch"] == b["bytes_per_batch"]
+    assert a["decode_cache_misses"] == b["decode_cache_misses"] == 0
