@@ -254,7 +254,8 @@ _EXTRA_KEYS = ("matmul_tflops", "rtt_ms", "batch", "warp_impl",
                "mfu_vs_matmul", "compile_cache_requests",
                "compile_cache_hits", "compile_cache_misses",
                "decode_cache_hits", "decode_cache_misses",
-               "decode_cache_evictions")
+               "decode_cache_evictions", "dev_mem_bytes_in_use",
+               "dev_mem_peak_bytes")
 
 
 def _save_last_good(res: dict) -> None:
@@ -410,11 +411,10 @@ def headline_setup(model_name: str = "inception_v3", batch: int = 16,
     return cfg, mesh, ds, model, state, step, b
 
 
-# Nominal dense bf16 peak of the chip this container tunnels to (v5e:
-# 197 TFLOP/s). Used only to turn measured model-FLOP throughput into an
-# absolute MFU figure; `mfu_vs_matmul` (vs the concurrently measured raw
-# matmul rate) is the tunnel-condition-independent one.
-NOMINAL_BF16_TFLOPS = 197.0
+# The nominal bf16 chip peak used for `mfu_nominal` lives in
+# deepof_tpu/obs/telemetry.py (single source of truth, shared with the
+# train loop's per-record telemetry); imported lazily inside bench() so
+# the orchestrating parent stays stdlib-only at import.
 
 
 def time_train_step(step, state, b, steps: int = 10, windows: int = 3,
@@ -446,17 +446,14 @@ def time_train_step(step, state, b, steps: int = 10, windows: int = 3,
 
 def step_flops(step, state, b) -> float | None:
     """XLA's own FLOPs estimate for one train step, from the LOWERED
-    module (`jit(...).lower(...).cost_analysis()`) — no second backend
-    compile, which matters on a tunnel whose compile latency swings;
-    None if the backend does not report it."""
-    try:
-        ca = step.lower(state, b).cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:  # noqa: BLE001 - cost model is best-effort
-        return None
+    module — no second backend compile, which matters on a tunnel whose
+    compile latency swings; None if the backend does not report it.
+    Implementation shared with the train loop's per-record telemetry
+    (deepof_tpu/obs/telemetry.py); imported lazily for the stdlib-only
+    parent."""
+    from deepof_tpu.obs.telemetry import step_flops as _step_flops
+
+    return _step_flops(step, state, b)
 
 
 HEADLINE_CONFIG = ("inception_v3", 16, (320, 448))
@@ -525,6 +522,15 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
         res["decode_cache_hits"] = int(dstats["hits"])
         res["decode_cache_misses"] = int(dstats["misses"])
         res["decode_cache_evictions"] = int(dstats["evictions"])
+    # Device-memory telemetry (obs/telemetry.py): the same
+    # bytes-in-use/peak fields the train loop logs per record, so a
+    # bench line also answers "how close to HBM is this config". Null
+    # fields (cpu backend) are dropped from the one-line output.
+    from deepof_tpu.obs.telemetry import (
+        NOMINAL_BF16_TFLOPS, device_memory_summary)
+
+    res.update({k: v for k, v in device_memory_summary().items()
+                if v is not None})
     # MFU: XLA-counted FLOPs/step x measured steps/sec, vs both the
     # nominal chip peak and the concurrently measured matmul rate (the
     # latter cancels tunnel-condition swings — DESIGN.md).

@@ -17,6 +17,8 @@ from __future__ import annotations
 import json
 import math
 import os
+import statistics
+import time
 from collections import defaultdict
 
 
@@ -39,6 +41,47 @@ def load_records(log_dir: str, filename: str = "metrics.jsonl") -> list[dict]:
             except json.JSONDecodeError:
                 continue  # tolerate torn writes from a killed run
     return records
+
+
+def _phase_breakdown(rec: dict) -> dict | None:
+    """Host-phase share of accounted loop time from ONE train record.
+
+    `phase_<name>_s` fields are cumulative totals (StepTimer), so the
+    freshest record carries the whole run so far; shares are each
+    phase's fraction of the summed phase time (assemble / put / dispatch
+    / fetch — note put+fetch run on background threads, so shares answer
+    "where does host work go", not "what serializes the main thread").
+    """
+    phases = {k[len("phase_"):-len("_s")]: r
+              for k, r in rec.items()
+              if k.startswith("phase_") and k.endswith("_s")
+              and isinstance(r, (int, float)) and math.isfinite(r)}
+    total = sum(phases.values())
+    if not phases or total <= 0:
+        return None
+    return {
+        "seconds": {k: round(v, 4) for k, v in sorted(phases.items())},
+        "share": {k: round(v / total, 4) for k, v in sorted(phases.items())},
+    }
+
+
+def _counter_summary(rec: dict) -> dict | None:
+    """Starvation + input-pipeline counters from one (cumulative) train
+    record. `starvation_rate` approximates starved dispatches per
+    trained step (with steps_per_call=K one dispatch serves K steps, so
+    the per-dispatch rate is at most 1/K of the per-step figure)."""
+    out: dict = {}
+    step = rec.get("step", 0)
+    starved = rec.get("starved")
+    if isinstance(starved, (int, float)):
+        out["starved"] = starved
+        if isinstance(step, int) and step > 0:
+            out["starvation_rate"] = round(starved / step, 6)
+    data = {k[len("data_"):]: v for k, v in rec.items()
+            if k.startswith("data_")}
+    if data:
+        out["data"] = data
+    return out or None
 
 
 def summarize(records: list[dict]) -> dict:
@@ -64,6 +107,15 @@ def summarize(records: list[dict]) -> dict:
             "last_lr": last.get("lr"),
             "items_per_sec_per_chip": last.get("items_per_sec_per_chip"),
         }
+        # phase/counter aggregation rides on the freshest train record
+        # (phase_*_s / starved / data_* fields are cumulative totals)
+        newest = raw_train[-1]
+        phases = _phase_breakdown(newest)
+        if phases:
+            out["phases"] = phases
+        counters = _counter_summary(newest)
+        if counters:
+            out["counters"] = counters
 
     evals = _finite(by_kind.get("eval", []), "aee")
     if evals:
@@ -84,6 +136,100 @@ def summarize(records: list[dict]) -> dict:
     warns = by_kind.get("warn", [])
     if warns:
         out["warnings"] = [r.get("message", "") for r in warns[-5:]]
+    return out
+
+
+def load_heartbeat(log_dir: str) -> dict | None:
+    """The run's heartbeat.json (obs/heartbeat.py), or None. The file is
+    atomically rewritten, so a read never sees a torn record."""
+    try:
+        with open(os.path.join(log_dir, "heartbeat.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def tail_summary(log_dir: str, recent: int = 10,
+                 now: float | None = None) -> dict:
+    """One-glance health of a LIVE or finished run (`deepof_tpu tail`):
+    where it is, whether it is moving, how fast recently vs overall,
+    where host time goes, and how stale the heartbeat is.
+
+    recent: train records in the throughput-trend window. The per-record
+    `steps_per_sec` is a since-start cumulative average, so the recent
+    rate is recomputed from the newest records' (step, time) gaps —
+    median of per-gap slopes, robust to one eval/ckpt pause inside the
+    window — the number that answers "is it slowing down?".
+    """
+    records = load_records(log_dir)
+    now = time.time() if now is None else now
+    out: dict = {"log_dir": log_dir, "records": len(records)}
+    if records:
+        t = records[-1].get("time")
+        if isinstance(t, (int, float)):
+            out["last_record_age_s"] = round(now - t, 1)
+
+    train = [r for r in records if r.get("kind") == "train"]
+    if train:
+        last = train[-1]
+        out["step"] = last.get("step")
+        out["loss"] = last.get("loss")
+        out["steps_per_sec"] = last.get("steps_per_sec")
+        out["items_per_sec_per_chip"] = last.get("items_per_sec_per_chip")
+        for k in ("model_tflops", "mfu_nominal", "dev_mem_bytes_in_use",
+                  "dev_mem_peak_bytes", "rss_bytes"):
+            if last.get(k) is not None:
+                out[k] = last[k]
+        window = [r for r in train[-max(recent, 2):]
+                  if isinstance(r.get("time"), (int, float))
+                  and isinstance(r.get("step"), int)]
+        if len(window) >= 2:
+            # median of per-gap slopes, not one end-to-end slope: an
+            # eval sweep / checkpoint inside the window stretches ONE
+            # gap's wall time (the cumulative steps_per_sec excludes
+            # those pauses via StepTimer), and a single stretched gap
+            # must not read as a run-wide slowdown
+            gap_rates = []
+            for a, b in zip(window, window[1:]):
+                dt, dstep = b["time"] - a["time"], b["step"] - a["step"]
+                if dt > 0 and dstep > 0:
+                    gap_rates.append(dstep / dt)
+            if gap_rates:
+                rsps = statistics.median(gap_rates)
+                out["recent_steps_per_sec"] = round(rsps, 4)
+                overall = last.get("steps_per_sec")
+                if isinstance(overall, (int, float)) and overall > 0:
+                    # >1: speeding up; <1: the recent window is slower
+                    # than the run's average
+                    out["throughput_trend"] = round(rsps / overall, 3)
+        phases = _phase_breakdown(last)
+        if phases:
+            out["phase_share"] = phases["share"]
+        counters = _counter_summary(last)
+        if counters:
+            out.update({k: v for k, v in counters.items() if k != "data"})
+
+    evals = [r for r in records if r.get("kind") == "eval"]
+    if evals:
+        out["last_eval"] = {k: evals[-1][k] for k in ("step", "aee", "aae",
+                                                      "accuracy")
+                            if k in evals[-1]}
+    warns = [r for r in records if r.get("kind") == "warn"]
+    if warns:
+        out["warnings"] = len(warns)
+        out["last_warning"] = str(warns[-1].get("message", ""))[:200]
+
+    hb = load_heartbeat(log_dir)
+    if hb is not None:
+        entry = {"step": hb.get("step"), "wedged": hb.get("wedged"),
+                 "wedges": hb.get("wedges"),
+                 "last_step_age_s": hb.get("last_step_age_s")}
+        t = hb.get("time")
+        if isinstance(t, (int, float)):
+            # fresh: age < ~2x the period => the writer thread is alive
+            entry["age_s"] = round(now - t, 1)
+            entry["period_s"] = hb.get("heartbeat_period_s")
+        out["heartbeat"] = entry
     return out
 
 

@@ -108,7 +108,18 @@ def main(argv=None) -> int:
     p_train.add_argument("--epochs", type=int, default=None)
     p_train.add_argument("--max-steps", "--steps", dest="max_steps",
                          type=int, default=None)
-    p_train.add_argument("--profile", action="store_true")
+    p_train.add_argument("--profile", action="store_true",
+                         help="whole-run jax.profiler trace (includes "
+                              "compile; grows with run length)")
+    p_train.add_argument("--profile-steps", default=None, metavar="K:N",
+                         help="jax.profiler trace of steps K..N only "
+                              "(excludes compile, stays small enough to "
+                              "fetch over the tunnel)")
+    p_train.add_argument("--trace", action="store_true",
+                         help="cross-thread span timeline to "
+                              "<log-dir>/trace.json (Perfetto/"
+                              "chrome://tracing loadable) — shorthand "
+                              "for --set obs.trace=true")
 
     p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
     _add_common(p_eval)
@@ -159,7 +170,36 @@ def main(argv=None) -> int:
     p_an.add_argument("--log-dir", required=True)
     p_an.add_argument("--no-plot", action="store_true")
 
+    p_tail = sub.add_parser(
+        "tail", help="one-glance health of a live or finished run: step, "
+                     "loss, recent vs overall throughput, phase shares, "
+                     "starvation, heartbeat age")
+    p_tail.add_argument("--log-dir", required=True)
+    p_tail.add_argument("--recent", type=int, default=10,
+                        help="train records in the throughput-trend window")
+    p_tail.add_argument("--follow", action="store_true",
+                        help="re-print every --interval seconds until ^C")
+    p_tail.add_argument("--interval", type=float, default=10.0)
+
     args = parser.parse_args(argv)
+
+    if args.cmd == "tail":
+        # jax-free like analyze: tailing a run must never touch the
+        # accelerator the trainer holds
+        from .analyze import tail_summary
+
+        while True:
+            try:
+                summary = tail_summary(args.log_dir, recent=args.recent)
+            except FileNotFoundError:
+                raise SystemExit(f"no metrics.jsonl under {args.log_dir!r} "
+                                 "— is this a run's --log-dir?")
+            print(json.dumps(summary), flush=True)
+            if not args.follow:
+                return 0
+            import time as _time
+
+            _time.sleep(max(args.interval, 0.1))
 
     if args.cmd == "analyze":
         # deliberately light import: must not pull in jax / the train stack
@@ -239,11 +279,29 @@ def main(argv=None) -> int:
 
     from .train.loop import Trainer, install_preemption_latch
 
+    profile_steps = None
+    if getattr(args, "profile_steps", None):
+        try:
+            k, n = (int(x) for x in args.profile_steps.split(":"))
+        except ValueError:
+            raise SystemExit(
+                f"bad --profile-steps {args.profile_steps!r}: use K:N "
+                "(start:stop global steps)")
+        if not 0 <= k < n:  # same clean exit as the syntax error above
+            raise SystemExit(
+                f"bad --profile-steps {args.profile_steps!r}: need "
+                "0 <= K < N")
+        profile_steps = (k, n)
+    if getattr(args, "trace", False):
+        import dataclasses as _dc
+
+        cfg = cfg.replace(obs=_dc.replace(cfg.obs, trace=True))
     if args.cmd == "train":
         # before Trainer(): model build + first compile can take minutes,
         # and a preemption SIGTERM in that window must still checkpoint
         install_preemption_latch()
-    trainer = Trainer(cfg, profile=getattr(args, "profile", False))
+    trainer = Trainer(cfg, profile=getattr(args, "profile", False),
+                      profile_steps=profile_steps)
     if args.cmd == "train":
         out = trainer.fit(num_epochs=args.epochs, max_steps=args.max_steps)
         print(json.dumps({k: float(v) for k, v in out.items()}))

@@ -239,6 +239,40 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Unified observability layer (deepof_tpu/obs/): cross-thread span
+    tracing, liveness heartbeat + wedge watchdog, and train-record
+    telemetry. DESIGN.md "Observability" explains what each instrument
+    answers."""
+
+    # Ring-buffered span tracer: fit() writes a Perfetto/chrome://tracing
+    # loadable Chrome trace-event timeline to <log_dir>/trace.json
+    # (main-thread dispatch/eval/ckpt, prefetch put, fetcher fetch,
+    # pipeline-worker assemble — the thread overlap made visible).
+    trace: bool = False
+    # Max retained span events (bounded memory; newest win — the window
+    # leading into a stall is the one that matters).
+    trace_ring: int = 16384
+    # Background liveness file: <log_dir>/heartbeat.json atomically
+    # rewritten every heartbeat_period_s with step, rates, queue/staged
+    # depths, device memory, and process RSS — progress is one `cat`
+    # (or `deepof_tpu tail`) away, even from outside the process.
+    heartbeat: bool = True
+    heartbeat_period_s: float = 5.0
+    # Wedge watchdog: declare a stall when no step completes within
+    # watchdog_factor x a robust (median) recent-step-time estimate,
+    # floored by watchdog_min_s (so eval pauses / scheduler jitter never
+    # fire). On a wedge: all thread stacks dumped to the metrics log,
+    # trace ring flushed. Observe-and-report only — never kills the run.
+    watchdog_factor: float = 20.0
+    watchdog_min_s: float = 60.0
+    # XLA cost-analysis FLOPs at first step (lower-only, no extra
+    # compile): every periodic train record then carries model_tflops +
+    # nominal MFU — the bench-only telemetry, promoted into training.
+    flops: bool = True
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "flyingchairs_flownet_s"
     # any models/registry.py name: flownet_s | vgg16 | inception_v3 |
@@ -266,6 +300,7 @@ class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -67,4 +67,10 @@ def force_cpu_devices(n: int = 8) -> None:
     # tests re-reading a previous session's entries; accepted here for
     # the ~35 min/session compile saving — the suite has been empirically
     # stable — while the CLI/bench default (auto) stays off on cpu.
+    # r07 addendum: a NEW in-process Trainer.fit() test whose train-step
+    # executable is already cached crashed 4/4 warm runs (rc=139/134 at
+    # steady-state pjit dispatch, reproduced with every obs feature
+    # disabled) — fit-shaped integration tests should drive the CLI in a
+    # subprocess instead, where the cpu auto-gate keeps the cache off
+    # (tests/test_obs.py::test_fit_writes_trace_heartbeat_and_telemetry).
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -41,6 +41,8 @@ from typing import Callable
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 
 def derive_batch_rng(base_seed, batch_index: int) -> np.random.RandomState:
     """Deterministic per-batch rng: (stream seed, batch index) -> rng.
@@ -128,7 +130,8 @@ class InputPipeline:
                 self._next_claim += 1
             t0 = time.perf_counter()
             try:
-                batch = self._make(i)
+                with obs_trace.span("assemble", index=i):
+                    batch = self._make(i)
             except BaseException as e:  # noqa: BLE001 - surfaced on get()
                 with self._cv:
                     if self._exc is None:
@@ -157,7 +160,8 @@ class InputPipeline:
                 self._next_out += 1
             t0 = time.perf_counter()
             try:
-                batch = self._make(i)
+                with obs_trace.span("assemble", index=i):
+                    batch = self._make(i)
             except BaseException as e:  # noqa: BLE001 - one idiom for both paths
                 with self._cv:
                     if self._exc is None:

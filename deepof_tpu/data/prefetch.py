@@ -24,6 +24,8 @@ from typing import Callable, Iterator
 
 import jax
 
+from ..obs import trace as obs_trace
+
 
 class Prefetcher:
     """Wraps a batch-producing callable into a prefetching iterator.
@@ -52,21 +54,23 @@ class Prefetcher:
         self._stop = threading.Event()
         self._exc: BaseException | None = None
         self._max_depth = 0  # peak staged-batch count (GIL-atomic update)
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="prefetch")
         self._thread.start()
 
     def _place(self, batch: dict) -> dict:
         t0 = time.perf_counter()
-        if self._sharding is not None:
-            # multi-process: producer yields this host's local rows
-            # and the global array is assembled shard-wise
-            from ..parallel.mesh import put_global
+        with obs_trace.span("put"):
+            if self._sharding is not None:
+                # multi-process: producer yields this host's local rows
+                # and the global array is assembled shard-wise
+                from ..parallel.mesh import put_global
 
-            batch = put_global(batch, self._sharding)
-        elif self._stage:
-            batch = jax.device_put(batch)
-        if self._stage:
-            jax.block_until_ready(batch)
+                batch = put_global(batch, self._sharding)
+            elif self._stage:
+                batch = jax.device_put(batch)
+            if self._stage:
+                jax.block_until_ready(batch)
         if self._phase_cb is not None:
             self._phase_cb("put", time.perf_counter() - t0)
         return batch

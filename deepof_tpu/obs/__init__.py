@@ -1,0 +1,30 @@
+"""Unified observability layer (L0 — stdlib-only at import).
+
+The stack is genuinely concurrent (prefetch thread, N pipeline workers,
+AsyncFetcher consumer, staged device puts), and scalar counters cannot
+show *when* those threads overlapped, stalled, or wedged. This package
+holds the instruments that can:
+
+  trace.py      lock-cheap ring-buffered span tracer emitting Chrome
+                trace-event JSON (load artifacts' trace.json in Perfetto
+                / chrome://tracing) — the cross-thread timeline that
+                makes dispatch/put/fetch/assemble overlap visible
+                instead of inferred from phase totals.
+  heartbeat.py  background thread atomically rewriting heartbeat.json
+                (step, rates, queue depths, device memory, RSS) plus a
+                wedge watchdog: no step within k x a robust recent
+                step-time estimate => all thread stacks dumped to the
+                log and the trace ring flushed.
+  telemetry.py  process/device sampling shared by training and bench:
+                XLA cost-analysis FLOPs (model TFLOP/s + nominal MFU),
+                per-device memory_stats, process RSS.
+
+Import discipline: this __init__ and trace.py import only the stdlib
+(`bench.py`'s orchestrating parent and `analyze.py` may import them
+without initializing an accelerator backend); telemetry.py defers its
+jax imports into the sampling functions for the same reason.
+"""
+
+from . import trace
+
+__all__ = ["trace"]

@@ -1,0 +1,91 @@
+"""Process + device telemetry sampling, shared by training and bench.
+
+Promotes what used to be bench-only instrumentation into the training
+loop: XLA's own cost-analysis FLOPs (so every fit() can log model
+TFLOP/s and a nominal MFU, not just bench.py), per-device
+`memory_stats()` (HBM bytes-in-use / peak), and process RSS.
+
+Import discipline: jax is imported lazily inside the functions —
+importing this module must stay side-effect free (bench.py's
+orchestrating parent and the heartbeat thread both import it without
+wanting a backend initialized; see obs/__init__).
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Nominal dense bf16 peak of the chip this container tunnels to (v5e:
+#: 197 TFLOP/s). Single source of truth — bench.py and the train loop
+#: both compute `mfu_nominal` against it.
+NOMINAL_BF16_TFLOPS = 197.0
+
+
+def step_flops(step, *example_args) -> float | None:
+    """XLA's FLOPs estimate for one call of a jitted `step`, from the
+    LOWERED module (`jit(...).lower(...).cost_analysis()`) — traces but
+    never compiles on the backend (matters on a tunnel whose compile
+    latency swings). Lowered cost analysis reports GLOBAL
+    (pre-partition) FLOPs, and a lax.scan body is counted ONCE, so the
+    value is per-optimizer-step for any steps_per_call (bench.py has the
+    verification notes). None when the backend does not report it."""
+    try:
+        ca = step.lower(*example_args).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 - cost model is best-effort
+        return None
+
+
+def process_rss_bytes() -> int | None:
+    """Resident set size of this process (host RAM actually mapped) —
+    the input pipeline's decoded-image cache, reorder buffers, and any
+    leak all show up here. Linux /proc; None elsewhere."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def device_memory_stats() -> list[dict]:
+    """Per-device `memory_stats()` snapshot. Fields are None where the
+    backend does not report (the cpu PJRT client returns no stats);
+    callers decide whether to surface or drop the nulls."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:  # noqa: BLE001 - never let sampling kill a run
+            ms = None
+        out.append({
+            "device": str(d),
+            "bytes_in_use": ms.get("bytes_in_use") if ms else None,
+            "peak_bytes_in_use": ms.get("peak_bytes_in_use") if ms else None,
+        })
+    return out
+
+
+def device_memory_summary() -> dict:
+    """Max bytes-in-use / peak across devices, log-record keyed.
+
+    Max (not sum): with replicated params + sharded batches the hottest
+    chip is the one that OOMs, so the headroom question is per-device.
+    Keys are always present (None on backends without stats) so a
+    record's schema does not depend on the backend — `MetricsLogger`
+    serializes None as null.
+    """
+    stats = device_memory_stats()
+    in_use = [s["bytes_in_use"] for s in stats
+              if s["bytes_in_use"] is not None]
+    peak = [s["peak_bytes_in_use"] for s in stats
+            if s["peak_bytes_in_use"] is not None]
+    return {
+        "dev_mem_bytes_in_use": max(in_use) if in_use else None,
+        "dev_mem_peak_bytes": max(peak) if peak else None,
+    }

@@ -1,0 +1,232 @@
+"""Lock-cheap, ring-buffered span tracer -> Chrome trace-event JSON.
+
+Every instrumented site (`train/loop.py` dispatch/eval/ckpt/rollback,
+`train/metrics_log.py` fetch, `data/prefetch.py` put, `data/pipeline.py`
+worker assemble) calls the module-level `span(name, **args)`; with no
+tracer installed that is one global read + a shared no-op context
+manager, so instrumentation costs nothing when tracing is off and the
+instrumented modules never need a tracer threaded through their
+constructors.
+
+Design constraints, in order:
+
+  - The hot path takes NO lock: completed spans are appended to a
+    `collections.deque(maxlen=ring_size)` — append and the implicit
+    oldest-eviction are single C-level ops, atomic under the GIL, so
+    pipeline workers / prefetch / fetcher / main all record concurrently
+    without contending. Memory is bounded by construction: the ring
+    keeps the newest `ring_size` spans (the window that matters when a
+    watchdog fires).
+  - Timestamps come from `time.perf_counter()` (CLOCK_MONOTONIC —
+    comparable across threads of one process), rebased to the tracer's
+    construction so `ts` starts near zero.
+  - `flush()` writes the Chrome trace-event format (JSON object with a
+    `traceEvents` list of "X" complete events + "M" thread-name
+    metadata) atomically (tmp + rename), so a viewer — or the watchdog,
+    which flushes mid-run — never reads a torn file. Perfetto and
+    chrome://tracing both load it directly.
+
+Stdlib-only at import (see obs/__init__ docstring).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+class _NullSpan:
+    """Shared, stateless no-op context manager (safe to re-enter from
+    any number of threads at once)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class NullTracer:
+    """The uninstalled state: every operation is a no-op."""
+
+    path: str | None = None
+
+    def span(self, name: str, **args) -> _NullSpan:
+        return _NULL_SPAN
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def flush(self, path: str | None = None) -> str | None:
+        return None
+
+
+class _Span:
+    """One live span: created by Tracer.span, records on __exit__."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict | None):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._record(self._name, self._t0, time.perf_counter(),
+                             self._args)
+        return False
+
+
+class Tracer:
+    """Ring-buffered span recorder; see module docstring.
+
+    path: default flush destination (conventionally
+        `<log_dir>/trace.json`).
+    ring_size: max retained events — spans beyond it evict the oldest
+        (bounded memory; a full training run keeps its newest window).
+    """
+
+    def __init__(self, path: str | None = None, ring_size: int = 16384):
+        self.path = path
+        self.ring_size = max(int(ring_size), 16)
+        self._events: deque = deque(maxlen=self.ring_size)
+        self._epoch = time.perf_counter()
+        self._epoch_unix = time.time()
+        # tid -> thread name, captured at first event from that thread.
+        # Plain dict: item assignment is GIL-atomic, and a benign
+        # double-write of the same name is harmless.
+        self._threads: dict[int, str] = {}
+        self._dropped = 0  # informational; deque eviction is implicit
+
+    # ------------------------------------------------------------ record
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker (ph='i') — e.g. the watchdog's wedge."""
+        now = time.perf_counter()
+        self._note_thread()
+        self._events.append(("i", name, threading.get_ident(),
+                             (now - self._epoch) * 1e6, 0.0, args or None))
+
+    def _note_thread(self) -> None:
+        # unconditional (last-writer-wins) setitem: one GIL-atomic dict
+        # op, and an ident REUSED by a later thread maps to the name of
+        # the thread that most recently emitted under it (the OS may
+        # recycle idents of finished threads; Chrome's tid-keyed format
+        # cannot distinguish them anyway)
+        self._threads[threading.get_ident()] = threading.current_thread().name
+
+    def _record(self, name: str, t0: float, t1: float,
+                args: dict | None) -> None:
+        self._note_thread()
+        if len(self._events) == self.ring_size:
+            self._dropped += 1  # append below evicts the oldest
+        self._events.append(("X", name, threading.get_ident(),
+                             (t0 - self._epoch) * 1e6, (t1 - t0) * 1e6,
+                             args))
+
+    # ------------------------------------------------------------- flush
+    def events(self) -> list[dict]:
+        """Chrome trace-event dicts for the current ring contents."""
+        pid = os.getpid()
+        out: list[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "deepof_tpu"},
+        }]
+        # snapshot first (C-level copies are GIL-atomic; iterating the
+        # live deque while writers append is not)
+        threads = dict(self._threads)
+        events = list(self._events)
+        for tid in sorted(threads):
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid, "args": {"name": threads[tid]}})
+        for ph, name, tid, ts, dur, args in events:
+            ev: dict = {"ph": ph, "name": name, "cat": "obs", "pid": pid,
+                        "tid": tid, "ts": round(ts, 1)}
+            if ph == "X":
+                ev["dur"] = round(dur, 1)
+            else:
+                ev["s"] = "g"  # instants render process-wide
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def flush(self, path: str | None = None) -> str | None:
+        """Atomically write the trace file; safe to call repeatedly and
+        from any thread (the watchdog flushes mid-run, fit() at close —
+        later flushes simply rewrite with more events)."""
+        path = path or self.path
+        if path is None:
+            return None
+        payload = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "trace_epoch_unix": self._epoch_unix,
+                "ring_size": self.ring_size,
+                "dropped_spans": self._dropped,
+            },
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+
+
+# --------------------------------------------------------------- current
+# Module-level current tracer: instrumented code calls obs.trace.span()
+# unconditionally; fit() installs a real Tracer for its lifetime when
+# ObsConfig.trace is on and uninstalls (back to the no-op) in its finally.
+_NULL = NullTracer()
+_current: Tracer | NullTracer = _NULL
+_install_lock = threading.Lock()
+
+
+def install(tracer: Tracer) -> Tracer:
+    """Make `tracer` the process-current tracer (returns it)."""
+    global _current
+    with _install_lock:
+        _current = tracer
+    return tracer
+
+
+def uninstall() -> None:
+    """Back to the no-op tracer."""
+    global _current
+    with _install_lock:
+        _current = _NULL
+
+
+def current() -> Tracer | NullTracer:
+    return _current
+
+
+def span(name: str, **args):
+    """Record a span on the current tracer (no-op when none installed)."""
+    return _current.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    _current.instant(name, **args)
+
+
+def flush_current(path: str | None = None) -> str | None:
+    """Flush the installed tracer (the watchdog's entry point)."""
+    return _current.flush(path)

@@ -23,6 +23,14 @@ import jax.numpy as jnp
 from ..core.config import ExperimentConfig
 from ..data import InputPipeline, Prefetcher, build_dataset, derive_batch_rng
 from ..models.registry import build_model
+from ..obs import trace as obs_trace
+from ..obs.heartbeat import Heartbeat
+from ..obs.telemetry import (
+    NOMINAL_BF16_TFLOPS,
+    device_memory_summary,
+    process_rss_bytes,
+    step_flops,
+)
 from ..parallel.mesh import batch_sharding, build_mesh
 from .checkpoint import CheckpointManager
 from .evaluate import evaluate_aee, evaluate_ucf101
@@ -107,7 +115,8 @@ def _example_input(cfg: ExperimentConfig) -> jnp.ndarray:
 
 class Trainer:
     def __init__(self, cfg: ExperimentConfig, dataset=None, mesh=None,
-                 profile: bool = False):
+                 profile: bool = False,
+                 profile_steps: tuple[int, int] | None = None):
         self.cfg = cfg
         # Persistent compile cache BEFORE any compile (init, train, eval):
         # a process whose config was warmed (`deepof_tpu warmup`) or simply
@@ -126,7 +135,12 @@ class Trainer:
                                  corr_stride=cfg.corr_stride)
 
         self.logger = MetricsLogger(cfg.train.log_dir)
-        self.profiler = ProfilerSession(cfg.train.log_dir, enabled=profile)
+        self.profiler = ProfilerSession(cfg.train.log_dir, enabled=profile,
+                                        steps=profile_steps)
+        # XLA cost-analysis FLOPs per optimizer step, computed once at
+        # the first dispatch (obs/telemetry.py) — None until then, and on
+        # backends without a cost model.
+        self._flops_per_step: float | None = None
         self.steps_per_epoch = max(self.dataset.num_train // cfg.data.batch_size, 1)
         schedule = step_decay_schedule(cfg.optim, self.steps_per_epoch)
         self.schedule = schedule
@@ -315,6 +329,28 @@ class Trainer:
             ]
             return {key: _stack([b[key] for b in bs]) for key in bs[0]}
 
+        # --- Observability (DESIGN.md "Observability") ---
+        # Span tracer installed BEFORE the pipeline: its workers start
+        # assembling eagerly at construction, and those spans belong on
+        # the timeline. Single-writer (primary process only), same
+        # rationale as MetricsLogger; uninstalled + flushed in finally.
+        primary = jax.process_index() == 0
+        tracer = None
+        if cfg.obs.trace and primary:
+            tracer = obs_trace.install(obs_trace.Tracer(
+                path=os.path.join(cfg.train.log_dir, "trace.json"),
+                ring_size=cfg.obs.trace_ring))
+
+        def _obs_teardown() -> None:
+            # construction-failure path: the process-global tracer must
+            # not outlive this fit (a later fit/eval would silently
+            # record into the dead run's ring); flush what was collected
+            if tracer is not None:
+                obs_trace.uninstall()
+                try:
+                    tracer.flush()
+                except OSError:
+                    pass
         timer = StepTimer(cfg.data.batch_size, len(self.mesh.devices.flat))
         # Multi-worker host assembly (data/pipeline.py): N threads
         # decode/augment/stack out-of-order, delivery stays in index
@@ -333,6 +369,7 @@ class Trainer:
                                   phase_cb=timer.phase)
         except BaseException:
             pipeline.close()
+            _obs_teardown()
             raise
         # In-flight metrics pipelining (DESIGN.md "Execution layer"):
         # depth > 0 drains value fetches on a background consumer so the
@@ -346,7 +383,40 @@ class Trainer:
         except BaseException:  # same leak guard as the Prefetcher above
             pipeline.close()
             prefetch.close()
+            _obs_teardown()
             raise
+        # Liveness heartbeat + wedge watchdog (obs/heartbeat.py): a
+        # background thread atomically rewrites heartbeat.json with
+        # step/rates/depths/device-memory/RSS, and dumps every thread's
+        # stack to the log (+ flushes the trace ring) when no step
+        # completes within watchdog_factor x the median recent step time
+        # — the historical "hung fetch on a dead tunnel" becomes a
+        # diagnosable artifact instead of a silent stall.
+        heartbeat = None
+        if cfg.obs.heartbeat and primary:
+
+            def _hb_sample() -> dict:
+                return {**timer.rates(),
+                        **{f"data_{dk}": dv
+                           for dk, dv in pipeline.stats().items()},
+                        **{f"data_{dk}": dv
+                           for dk, dv in prefetch.stats().items()}}
+
+            try:
+                heartbeat = Heartbeat(
+                    os.path.join(cfg.train.log_dir, "heartbeat.json"),
+                    period_s=cfg.obs.heartbeat_period_s,
+                    watchdog_factor=cfg.obs.watchdog_factor,
+                    watchdog_min_s=cfg.obs.watchdog_min_s,
+                    sample=_hb_sample,
+                    log=lambda s, m: self.logger.log("warn", s, message=m),
+                    tracer=tracer)
+            except BaseException:  # same leak guard as above
+                fetcher.close()
+                pipeline.close()
+                prefetch.close()
+                _obs_teardown()
+                raise
         # Set by the fetch callback when a fetched step is non-finite;
         # the main loop converts it into a rollback at the next boundary
         # (at most `depth` extra dispatched calls late — all discarded by
@@ -445,14 +515,16 @@ class Trainer:
                            for dk, dv in pipeline.stats().items()},
                         **{f"data_{dk}": dv
                            for dk, dv in prefetch.stats().items()},
-                        **cache_kw)
+                        **cache_kw, **self._telemetry(timer))
 
             gstep = start_step
             consecutive_nans = 0
             metrics = None
             while gstep < total_steps and stop_sig["sig"] is None:
+                self.profiler.observe(gstep, k)  # --profile-steps window
                 t0 = time.perf_counter()
-                batch = prefetch.get()
+                with obs_trace.span("input_wait"):
+                    batch = prefetch.get()
                 wait = time.perf_counter() - t0
                 timer.phase("assemble", wait)
                 if wait > STARVED_WAIT_S:
@@ -463,9 +535,17 @@ class Trainer:
                 t0 = time.perf_counter()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
                     cache_watch = cache_delta()
-                    self.state, metrics = self.train_step(self.state, batch)
-                    jax.block_until_ready(metrics["total"])
+                    with obs_trace.span("dispatch", step=gstep + k,
+                                        compile=True):
+                        self.state, metrics = self.train_step(self.state,
+                                                              batch)
+                        jax.block_until_ready(metrics["total"])
                     dc = cache_watch.stats()
+                    if cfg.obs.flops:
+                        # lower-only retrace (no second backend compile);
+                        # every periodic record then carries model_tflops
+                        self._flops_per_step = step_flops(
+                            self.train_step, self.state, batch)
                     # hit/miss counters surfaced in metrics: a warmed
                     # process shows compile_cache_misses == 0 here
                     self.logger.log(
@@ -474,13 +554,18 @@ class Trainer:
                                 f"{time.perf_counter() - t0:.1f}s",
                         compile_cache_requests=dc["requests"],
                         compile_cache_hits=dc["hits"],
-                        compile_cache_misses=dc["misses"])
+                        compile_cache_misses=dc["misses"],
+                        flops_per_step=self._flops_per_step)
                     first_step = False
                 else:
-                    self.state, metrics = self.train_step(self.state, batch)
+                    with obs_trace.span("dispatch", step=gstep + k):
+                        self.state, metrics = self.train_step(self.state,
+                                                              batch)
                 timer.phase("dispatch", time.perf_counter() - t0)
                 timer.tick(k)
                 prev, gstep = gstep, gstep + k
+                if heartbeat is not None:
+                    heartbeat.beat(gstep)
                 epoch = gstep // self.steps_per_epoch
                 end_of_epoch = _crossed(prev, gstep, self.steps_per_epoch)
                 log_due = _crossed(prev, gstep, cfg.train.log_every) or end_of_epoch
@@ -527,6 +612,8 @@ class Trainer:
                     # target and the NaN step will re-fire as gstep
                     # re-crosses them (duplicate step records downstream)
                     timer.rewind(ckpt_mark)
+                    if heartbeat is not None:
+                        heartbeat.touch()  # restore device_puts took time
                     consecutive_nans += 1
                     if consecutive_nans >= 3:
                         raise FloatingPointError(
@@ -539,13 +626,19 @@ class Trainer:
                     consecutive_nans = 0
 
                 if eval_due:
-                    last_eval = self.evaluate(dump=cfg.train.dump_visuals)
+                    with obs_trace.span("eval", step=gstep):
+                        last_eval = self.evaluate(dump=cfg.train.dump_visuals)
                     self.logger.log("eval", gstep, epoch=epoch, **last_eval)
                     timer.pause()  # eval time is not training throughput
+                    if heartbeat is not None:
+                        heartbeat.touch()  # a long sweep is not a wedge
                 if ckpt_due:
-                    self.ckpt.save(self.state)
+                    with obs_trace.span("ckpt", step=gstep):
+                        self.ckpt.save(self.state)
                     ckpt_mark = timer.mark()
                     timer.pause()
+                    if heartbeat is not None:
+                        heartbeat.touch()
             self.profiler.maybe_stop()
             # all in-flight NaN checks land before finalize — but bounded:
             # a consumer wedged in a dead-tunnel device_get must not hang
@@ -590,6 +683,8 @@ class Trainer:
                             "back to the last good checkpoint instead of "
                             "saving the diverged state")
         finally:
+            if heartbeat is not None:
+                heartbeat.close()  # writes the final heartbeat.json state
             fetcher.close()
             # pipeline BEFORE prefetch: the prefetch thread may be
             # blocked inside pipeline.get() waiting on workers, which
@@ -600,6 +695,16 @@ class Trainer:
             pipeline.close()
             prefetch.close()
             self.ckpt.finalize()  # commit any in-flight async save
+            if tracer is not None:
+                # uninstall first: this fit's tracer must not keep
+                # collecting from a later fit()/eval; flush is
+                # best-effort (a read-only tree must not mask a body
+                # exception)
+                obs_trace.uninstall()
+                try:
+                    tracer.flush()
+                except OSError:
+                    pass
             # restore only AFTER finalize(): the final async-save commit
             # must stay protected by the graceful handler. A C-level
             # previous handler cannot be re-installed from Python
@@ -622,14 +727,38 @@ class Trainer:
                 **timer.counters(),
                 **{f"pipeline_{k}": v for k, v in fetcher.stats().items()},
                 **{f"data_{k}": v for k, v in pipeline.stats().items()},
-                **{f"data_{k}": v for k, v in prefetch.stats().items()}}
+                **{f"data_{k}": v for k, v in prefetch.stats().items()},
+                # telemetry (model_tflops/mfu_nominal/dev mem/rss);
+                # None-valued fields dropped — the summary stays
+                # float()-able for CLI printing
+                **{k: v for k, v in self._telemetry(timer).items()
+                   if v is not None}}
+
+    def _telemetry(self, timer: StepTimer) -> dict:
+        """Device-memory / RSS / model-FLOP fields for a train record
+        (obs/telemetry.py — the bench-only instrumentation, promoted).
+        Keys are schema-stable across backends: values the backend
+        cannot report serialize as null in metrics.jsonl."""
+        out = dict(device_memory_summary())
+        out["rss_bytes"] = process_rss_bytes()
+        if self._flops_per_step:
+            sps = timer.rates()["steps_per_sec"]
+            if sps > 0:
+                tfs = self._flops_per_step * sps / timer.n_chips / 1e12
+                # significant figures, not decimals: a cpu smoke's 1e-5
+                # TFLOP/s must not round to a meaningless 0.0
+                out["model_tflops"] = float(f"{tfs:.4g}")
+                out["mfu_nominal"] = float(f"{tfs / NOMINAL_BF16_TFLOPS:.4g}")
+        return out
 
     def _rollback(self, step: int) -> None:
-        restored = self.ckpt.restore(self.state)
-        if restored is None:
-            raise FloatingPointError(f"loss diverged to NaN at step {step} "
-                                     "with no checkpoint to roll back to")
-        self.state = restored
+        with obs_trace.span("rollback", step=step):
+            restored = self.ckpt.restore(self.state)
+            if restored is None:
+                raise FloatingPointError(
+                    f"loss diverged to NaN at step {step} "
+                    "with no checkpoint to roll back to")
+            self.state = restored
         self.logger.log("warn", step,
                         message=f"NaN at step {step}; rolled back to "
                                 f"step {int(restored.step)}")

@@ -17,6 +17,7 @@ previous call's fetch is still in flight.
 from __future__ import annotations
 
 import json
+import math
 import os
 import queue
 import threading
@@ -25,12 +26,26 @@ import time
 import jax
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 
 def _scalarize(v):
-    if isinstance(v, (str, bool, int)):
+    if v is None or isinstance(v, (str, bool, int)):
         return v
     a = np.asarray(v)
     return a.tolist() if a.ndim else float(a)
+
+
+def _json_safe(v):
+    """Non-finite floats -> None: `json.dumps` would otherwise write bare
+    `NaN`/`Infinity` tokens — not JSON — into metrics.jsonl, breaking
+    strict parsers (analyze.py round-trips, jq, browsers). null keeps the
+    key visible (a NaN loss is information) while the file stays JSON."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    if isinstance(v, list):
+        return [_json_safe(x) for x in v]
+    return v
 
 
 class MetricsLogger:
@@ -54,9 +69,11 @@ class MetricsLogger:
         if not self._primary:
             return
         rec = {"kind": kind, "step": int(step), "time": time.time()}
-        rec.update({k: _scalarize(v) for k, v in metrics.items()})
+        rec.update({k: _json_safe(_scalarize(v)) for k, v in metrics.items()})
         with self._lock:
-            self._f.write(json.dumps(rec) + "\n")
+            # allow_nan=False backstops _json_safe: an unsanitized
+            # non-finite must fail loudly here, not corrupt the log
+            self._f.write(json.dumps(rec, allow_nan=False) + "\n")
             if self.echo:
                 brief = {k: (round(v, 6) if isinstance(v, float) else v)
                          for k, v in rec.items() if k != "time"}
@@ -194,7 +211,8 @@ class AsyncFetcher:
         self._max_in_flight = 0
         self._fetches = 0
         self._fetch_s = 0.0
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="metrics-fetcher")
         self._thread.start()
 
     def _run(self) -> None:
@@ -206,7 +224,8 @@ class AsyncFetcher:
             tag, tree, callback = item
             try:
                 t0 = time.perf_counter()
-                host = self._fetch(tree)
+                with obs_trace.span("fetch"):
+                    host = self._fetch(tree)
                 dt = time.perf_counter() - t0
                 with self._cv:
                     self._fetches += 1
@@ -291,7 +310,8 @@ class SyncFetcher:
 
     def submit(self, tag, tree, callback) -> None:
         t0 = time.perf_counter()
-        host = self._fetch(tree)
+        with obs_trace.span("fetch"):
+            host = self._fetch(tree)
         dt = time.perf_counter() - t0
         self._fetches += 1
         self._fetch_s += dt
@@ -311,19 +331,67 @@ class SyncFetcher:
 
 
 class ProfilerSession:
-    """Optional `jax.profiler` trace capture around N steps (SURVEY.md §5.1)."""
+    """Optional `jax.profiler` trace capture (SURVEY.md §5.1).
 
-    def __init__(self, log_dir: str, enabled: bool = False):
+    Two modes:
+      - whole-run (`enabled=True`, `steps=None`): the legacy behavior —
+        start at loop entry, stop at teardown. Includes the first-step
+        compile and grows with run length.
+      - step window (`steps=(K, N)`, e.g. `--profile-steps 5:10`): the
+        loop reports progress via `observe(gstep)`; the trace starts at
+        the first iteration with gstep >= K and stops once gstep >= N.
+        K >= steps_per_call excludes the compile step, and the bounded
+        window keeps the profile small enough to fetch over the tunnel
+        (a whole-run trace of a long fit can run to GBs).
+    """
+
+    def __init__(self, log_dir: str, enabled: bool = False,
+                 steps: tuple[int, int] | None = None):
         self.log_dir = os.path.join(log_dir, "profile")
-        self.enabled = enabled
+        if steps is not None:
+            start, stop = int(steps[0]), int(steps[1])
+            if not 0 <= start < stop:
+                raise ValueError(
+                    f"profile step window must be 0 <= start < stop, "
+                    f"got {steps}")
+            steps = (start, stop)
+        self.steps = steps
+        self.enabled = enabled or steps is not None
         self._active = False
+        self._done = False
 
     def maybe_start(self) -> None:
-        if self.enabled and not self._active:
-            jax.profiler.start_trace(self.log_dir)
-            self._active = True
+        """Loop entry: whole-run mode starts here; a step window waits
+        for observe() so the profile excludes compile + early steps."""
+        if self.enabled and self.steps is None and not self._active:
+            self._start()
+
+    def observe(self, gstep: int, steps_per_call: int = 1) -> None:
+        """Step-window driver, called once per loop iteration with the
+        completed-step count and the dispatch stride. Starts when the
+        NEXT dispatch would overlap [start, stop) — stride-proof: with
+        steps_per_call=K the observed gsteps advance by K, and a window
+        narrower than K must still capture the one dispatch that
+        contains it, not be silently skipped. Idempotent; one window per
+        session."""
+        if not self.enabled or self.steps is None or self._done:
+            return
+        start, stop = self.steps
+        if self._active:
+            if gstep >= stop:
+                self._stop()
+                self._done = True  # one window; never restart
+        elif gstep < stop and gstep + max(steps_per_call, 1) > start:
+            self._start()
 
     def maybe_stop(self) -> None:
         if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop()
+
+    def _start(self) -> None:
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+
+    def _stop(self) -> None:
+        jax.profiler.stop_trace()
+        self._active = False

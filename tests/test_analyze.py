@@ -58,6 +58,78 @@ def test_nan_records_excluded(tmp_path):
     json.dumps(s, allow_nan=False)
 
 
+def test_phase_and_counter_aggregation(tmp_path):
+    """summarize() folds the cumulative phase_*_s / starved / data_*
+    fields of the freshest train record into shares and rates."""
+    _write_log(tmp_path, [
+        {"kind": "train", "step": 100, "loss": 5.0,
+         "phase_assemble_s": 1.0, "phase_dispatch_s": 2.0,
+         "phase_fetch_s": 1.0, "starved": 2, "data_queue_depth": 1},
+        {"kind": "train", "step": 200, "loss": 3.0,
+         "phase_assemble_s": 2.0, "phase_dispatch_s": 5.0,
+         "phase_fetch_s": 3.0, "starved": 10, "data_queue_depth": 2,
+         "data_worker_util": 0.8},
+    ])
+    s = summarize(load_records(str(tmp_path)))
+    assert s["phases"]["seconds"] == {"assemble": 2.0, "dispatch": 5.0,
+                                      "fetch": 3.0}
+    share = s["phases"]["share"]
+    assert share["dispatch"] == 0.5
+    assert abs(sum(share.values()) - 1.0) < 1e-6
+    assert s["counters"]["starved"] == 10
+    assert s["counters"]["starvation_rate"] == 0.05  # 10 / 200 steps
+    assert s["counters"]["data"]["worker_util"] == 0.8
+    json.dumps(s, allow_nan=False)  # summary stays strict-JSON
+
+
+def test_tail_summary(tmp_path):
+    from deepof_tpu.analyze import tail_summary
+
+    now = 1000.0
+    _write_log(tmp_path, [
+        {"kind": "train", "step": 100, "time": now - 30, "loss": 5.0,
+         "steps_per_sec": 10.0, "items_per_sec_per_chip": 40.0},
+        {"kind": "train", "step": 200, "time": now - 20, "loss": 4.0,
+         "steps_per_sec": 10.0, "items_per_sec_per_chip": 40.0},
+        {"kind": "train", "step": 300, "time": now - 10, "loss": 3.0,
+         "steps_per_sec": 10.0, "items_per_sec_per_chip": 40.0,
+         "phase_dispatch_s": 3.0, "phase_assemble_s": 1.0, "starved": 3,
+         "model_tflops": 1.5, "rss_bytes": 123},
+        {"kind": "eval", "step": 300, "time": now - 9, "aee": 2.5},
+        {"kind": "warn", "step": 301, "time": now - 8, "message": "x"},
+    ])
+    with open(tmp_path / "heartbeat.json", "w") as f:
+        json.dump({"time": now - 4, "step": 300, "wedged": False,
+                   "wedges": 0, "last_step_age_s": 1.2,
+                   "heartbeat_period_s": 5.0}, f)
+    s = tail_summary(str(tmp_path), recent=3, now=now)
+    assert s["step"] == 300 and s["loss"] == 3.0
+    # slope over the recent window: 200 steps / 20 s
+    assert s["recent_steps_per_sec"] == 10.0
+    assert s["throughput_trend"] == 1.0
+    assert s["phase_share"] == {"assemble": 0.25, "dispatch": 0.75}
+    assert s["starved"] == 3 and s["starvation_rate"] == 0.01
+    assert s["model_tflops"] == 1.5 and s["rss_bytes"] == 123
+    assert s["last_eval"] == {"step": 300, "aee": 2.5}
+    assert s["warnings"] == 1 and s["last_warning"] == "x"
+    hb = s["heartbeat"]
+    assert hb["age_s"] == 4.0 and hb["wedged"] is False
+    assert hb["step"] == 300
+    json.dumps(s, allow_nan=False)
+
+
+def test_tail_summary_without_heartbeat(tmp_path):
+    from deepof_tpu.analyze import tail_summary
+
+    _write_log(tmp_path, [
+        {"kind": "train", "step": 10, "time": 5.0, "loss": 1.0},
+    ])
+    s = tail_summary(str(tmp_path), now=10.0)
+    assert s["step"] == 10
+    assert "heartbeat" not in s
+    assert s["last_record_age_s"] == 5.0
+
+
 def test_analyze_is_jax_free():
     """The tool must be usable next to a live trainer: importing it cannot
     initialize an accelerator backend."""

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Top-N longest spans (and per-name aggregates) from a trace.json.
+
+Companion to the obs/trace.py tracer: when there is no Perfetto at hand
+(headless host, mid-run triage over ssh), this prints the spans that
+dominated the timeline straight from the Chrome trace-event file.
+
+    python tools/trace_summary.py /tmp/run/trace.json --top 15
+    python tools/trace_summary.py trace.json --name dispatch
+
+Stdlib-only (like the tracer itself): usable next to a live trainer
+without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> tuple[list[dict], dict[int, str]]:
+    """(complete 'X' span events, tid -> thread name)."""
+    with open(path) as f:
+        payload = json.load(f)
+    events = payload.get("traceEvents", payload if isinstance(payload, list)
+                         else [])
+    threads = {e.get("tid"): e.get("args", {}).get("name", "?")
+               for e in events
+               if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    spans = [e for e in events
+             if e.get("ph") == "X" and isinstance(e.get("dur"), (int, float))]
+    return spans, threads
+
+
+def summarize(spans: list[dict], threads: dict[int, str], top: int,
+              name: str | None = None) -> str:
+    if name:
+        spans = [s for s in spans if s.get("name") == name]
+    lines = []
+    by_name: dict[str, list[float]] = defaultdict(list)
+    for s in spans:
+        by_name[s.get("name", "?")].append(float(s["dur"]))
+
+    lines.append(f"{len(spans)} spans, {len(by_name)} names, "
+                 f"{len(threads)} named threads")
+    lines.append("")
+    lines.append(f"{'name':<16} {'count':>6} {'total_ms':>10} "
+                 f"{'mean_ms':>9} {'max_ms':>9}")
+    for nm, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+        lines.append(f"{nm:<16} {len(durs):>6} {sum(durs) / 1e3:>10.1f} "
+                     f"{sum(durs) / len(durs) / 1e3:>9.2f} "
+                     f"{max(durs) / 1e3:>9.2f}")
+    lines.append("")
+    lines.append(f"top {top} longest spans:")
+    lines.append(f"{'dur_ms':>9} {'ts_ms':>10} {'thread':<18} "
+                 f"{'name':<16} args")
+    for s in sorted(spans, key=lambda s: -float(s["dur"]))[:top]:
+        thread = threads.get(s.get("tid"), str(s.get("tid")))
+        args = s.get("args") or {}
+        lines.append(f"{float(s['dur']) / 1e3:>9.2f} "
+                     f"{float(s.get('ts', 0)) / 1e3:>10.1f} "
+                     f"{thread:<18} {s.get('name', '?'):<16} "
+                     f"{json.dumps(args) if args else ''}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="print top-N longest spans from a Chrome trace-event "
+                    "trace.json (obs/trace.py output)")
+    p.add_argument("path", help="trace.json written by the span tracer")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--name", default=None,
+                   help="restrict the top-N listing to one span name")
+    args = p.parse_args(argv)
+    try:
+        spans, threads = load_events(args.path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    if not spans:
+        print("no complete ('X') span events in this trace")
+        return 0
+    print(summarize(spans, threads, args.top, args.name))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
