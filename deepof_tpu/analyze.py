@@ -77,11 +77,36 @@ def _counter_summary(rec: dict) -> dict | None:
         out["starved"] = starved
         if isinstance(step, int) and step > 0:
             out["starvation_rate"] = round(starved / step, 6)
+    res = _resilience_counters(rec)
+    if res:
+        out["resilience"] = res
     data = {k[len("data_"):]: v for k, v in rec.items()
             if k.startswith("data_")}
     if data:
         out["data"] = data
     return out or None
+
+
+#: Resilience-layer counters (cumulative, in train records AND the
+#: heartbeat): recovery activity an operator should see at a glance.
+_RESILIENCE_KEYS = (
+    "skipped_updates", "rollbacks",
+    "data_sample_retries", "data_quarantined", "data_substituted",
+    "data_retries", "pipeline_fetch_retries",
+    "ckpt_save_failures", "ckpt_restore_failures",
+    "ckpt_restore_fallbacks", "ckpt_verify_failures",
+)
+
+
+def _resilience_counters(rec: dict) -> dict:
+    """Nonzero resilience counters from one record (zero counters are
+    the healthy steady state and would only be noise)."""
+    out = {k: rec[k] for k in _RESILIENCE_KEYS
+           if isinstance(rec.get(k), (int, float)) and rec[k]}
+    out.update({k: v for k, v in rec.items()
+                if k.startswith("fault_") and isinstance(v, (int, float))
+                and v})
+    return out
 
 
 def summarize(records: list[dict]) -> dict:
@@ -230,6 +255,13 @@ def tail_summary(log_dir: str, recent: int = 10,
             entry["age_s"] = round(now - t, 1)
             entry["period_s"] = hb.get("heartbeat_period_s")
         out["heartbeat"] = entry
+        # heartbeat-carried resilience counters are fresher than the last
+        # train record (they update every period, records every
+        # log_every): merge per key with the heartbeat winning, so a
+        # recovery burst between log points surfaces within one period
+        res = {**out.get("resilience", {}), **_resilience_counters(hb)}
+        if res:
+            out["resilience"] = res
     return out
 
 

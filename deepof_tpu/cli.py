@@ -43,19 +43,20 @@ def _parse_value(raw: str):
 
 
 def _apply_override(cfg: ExperimentConfig, dotted: str, raw: str) -> ExperimentConfig:
-    """Set `section.field=value` (or a top-level `field=value`) on the frozen
-    config tree, returning a new config."""
+    """Set a dotted config path (`field`, `section.field`, or deeper —
+    e.g. `resilience.faults.decode_p`) on the frozen config tree,
+    returning a new config. Every intermediate node must be a dataclass
+    field of its parent."""
     value = _parse_value(raw)
-    parts = dotted.split(".")
-    if len(parts) == 1:
-        return cfg.replace(**{parts[0]: value})
-    if len(parts) != 2:
-        raise SystemExit(f"bad override {dotted!r}: use section.field=value")
-    section, field = parts
-    sub = getattr(cfg, section)
-    if not hasattr(sub, field):
-        raise SystemExit(f"unknown config field {dotted!r}")
-    return cfg.replace(**{section: dataclasses.replace(sub, **{field: value})})
+
+    def rec(node, parts: list[str]):
+        name, rest = parts[0], parts[1:]
+        if not (dataclasses.is_dataclass(node) and hasattr(node, name)):
+            raise SystemExit(f"unknown config field {dotted!r}")
+        new = rec(getattr(node, name), rest) if rest else value
+        return dataclasses.replace(node, **{name: new})
+
+    return rec(cfg, dotted.split("."))
 
 
 def _build_cfg(args) -> ExperimentConfig:
@@ -170,10 +171,18 @@ def main(argv=None) -> int:
     p_an.add_argument("--log-dir", required=True)
     p_an.add_argument("--no-plot", action="store_true")
 
+    p_vck = sub.add_parser(
+        "verify-ckpt",
+        help="offline manifest/checksum validation of every checkpoint "
+             "in a run directory (jax-free; nonzero exit on corruption)")
+    p_vck.add_argument("dir",
+                       help="a run's --log-dir or its ckpt/ subdirectory")
+
     p_tail = sub.add_parser(
         "tail", help="one-glance health of a live or finished run: step, "
                      "loss, recent vs overall throughput, phase shares, "
-                     "starvation, heartbeat age")
+                     "starvation, resilience counters, heartbeat age; "
+                     "exits nonzero if the heartbeat reports wedged")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
@@ -182,6 +191,22 @@ def main(argv=None) -> int:
     p_tail.add_argument("--interval", type=float, default=10.0)
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "verify-ckpt":
+        # jax-free by design (resilience/verify.py is stdlib-only): the
+        # manifests inventory files + crc32s, so validation runs from
+        # any machine, against a live run, without touching a backend
+        from .resilience.verify import verify_run
+
+        report = verify_run(args.dir)
+        print(json.dumps(report, indent=2))
+        if report["corrupt_steps"]:
+            return 1  # corruption is the nonzero-exit contract
+        if not report["checkpoints"]:
+            print(f"verify-ckpt: no checkpoints under {args.dir!r}",
+                  file=sys.stderr)
+            return 2
+        return 0
 
     if args.cmd == "tail":
         # jax-free like analyze: tailing a run must never touch the
@@ -195,6 +220,13 @@ def main(argv=None) -> int:
                 raise SystemExit(f"no metrics.jsonl under {args.log_dir!r} "
                                  "— is this a run's --log-dir?")
             print(json.dumps(summary), flush=True)
+            # a wedged run must fail scripted health checks loudly: rc 3
+            # when the heartbeat's watchdog has declared a wedge — in
+            # --follow mode the loop ends at the first wedged heartbeat
+            # (the run is no longer making the progress being followed)
+            hb = summary.get("heartbeat") or {}
+            if hb.get("wedged"):
+                return 3
             if not args.follow:
                 return 0
             import time as _time

@@ -15,6 +15,8 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..resilience.faults import FaultConfig
+
 
 @dataclass(frozen=True)
 class LossConfig:
@@ -273,6 +275,47 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance layer (deepof_tpu/resilience/, DESIGN.md
+    "Resilience"): the self-healing data path, verified checkpoints, the
+    graduated divergence-recovery ladder, and the deterministic fault
+    injector that chaos-tests all of them."""
+
+    # --- self-healing data path (resilience/healing.py) ---
+    # bounded retries (exponential backoff) per sample draw before the
+    # draw is quarantined and replaced by a deterministic substitute
+    # from the same derive_batch_rng stream (salted by the round)
+    data_retries: int = 2
+    data_backoff_s: float = 0.05
+    # quarantine-and-redraw rounds before giving up (every redraw
+    # failing means the data path is down, not one bad sample)
+    data_substitutes: int = 3
+    # re-attempts of a failed batch assembly on a pipeline worker
+    # (make_batch is index-pure, so a retry is bit-identical)
+    pipeline_retries: int = 1
+    # re-attempts of a failed device->host metric value fetch
+    fetch_retries: int = 2
+    # --- graduated divergence recovery (train/step.py + loop.py) ---
+    # rung 1: non-finite grads detected INSIDE the jitted step, before
+    # the update — the update is skipped in place (state unchanged,
+    # `skipped_updates` counter) instead of poisoning the params
+    skip_nonfinite: bool = True
+    # rung 2: escalate to the checkpoint rollback only after this many
+    # consecutively observed skipped updates (rung 3 — abort — stays the
+    # existing 3-failed-rollbacks ladder)
+    max_consecutive_skips: int = 5
+    # --- verified checkpoints (train/checkpoint.py) ---
+    # validate manifests (file inventory + checksums) on restore and
+    # fall back to the newest checkpoint that verifies
+    verify_checkpoints: bool = True
+    # --- deterministic fault injection (resilience/faults.py) ---
+    # disabled by default (and then never constructed: zero overhead);
+    # e.g. --set resilience.faults.enabled=true
+    #      --set resilience.faults.decode_p=0.05
+    faults: FaultConfig = field(default_factory=FaultConfig)
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "flyingchairs_flownet_s"
     # any models/registry.py name: flownet_s | vgg16 | inception_v3 |
@@ -301,6 +344,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -42,9 +42,11 @@ from typing import Callable
 import numpy as np
 
 from ..obs import trace as obs_trace
+from ..resilience.healing import retry_bounded
 
 
-def derive_batch_rng(base_seed, batch_index: int) -> np.random.RandomState:
+def derive_batch_rng(base_seed, batch_index: int,
+                     salt: int = 0) -> np.random.RandomState:
     """Deterministic per-batch rng: (stream seed, batch index) -> rng.
 
     `base_seed` is an int or a uint32 array (the train loop passes
@@ -53,6 +55,12 @@ def derive_batch_rng(base_seed, batch_index: int) -> np.random.RandomState:
     worker count and any assembly order, the pipeline's determinism
     contract. Base words and the index are both carried as uint32
     PAIRS, so 64-bit seeds and indices are folded in losslessly.
+
+    `salt` selects a SIBLING stream for the same (base, index) — the
+    self-healing data path's substitute draws (resilience/healing.py:
+    round r redraws a quarantined batch index from salt=r). salt=0
+    appends nothing, so existing streams are bit-identical to the
+    pre-salt implementation.
     """
     base = np.atleast_1d(np.asarray(base_seed, dtype=np.uint64))
     words = np.empty(2 * base.size + 2, np.uint32)
@@ -61,6 +69,12 @@ def derive_batch_rng(base_seed, batch_index: int) -> np.random.RandomState:
     idx = int(batch_index)
     words[-2] = idx & 0xFFFFFFFF
     words[-1] = (idx >> 32) & 0xFFFFFFFF
+    if salt:
+        s = int(salt)
+        words = np.concatenate([
+            words,
+            np.asarray([s & 0xFFFFFFFF, (s >> 32) & 0xFFFFFFFF], np.uint32),
+        ])
     return np.random.RandomState(words)
 
 
@@ -86,14 +100,24 @@ class InputPipeline:
         (2 x num_workers). Values below num_workers just idle the excess
         workers (never deadlock: the cursor's own batch is always
         claimable).
+    retries: re-attempts of a failed `make_batch(i)` before the error
+        dooms delivery (resilience layer: a transient IO/runtime error
+        on a pipeline worker no longer kills the run). Safe because
+        make_batch is a pure function of the index — a retry reproduces
+        the exact same batch. Only OSError/RuntimeError retry;
+        programming errors surface immediately.
+    backoff_s: initial sleep before a retry; doubles per attempt.
     """
 
     def __init__(self, make_batch: Callable[[int], dict],
-                 num_workers: int = 0, reorder_depth: int = 0):
+                 num_workers: int = 0, reorder_depth: int = 0,
+                 retries: int = 0, backoff_s: float = 0.05):
         self._make = make_batch
         self._n = max(int(num_workers), 0)
         self._depth = (int(reorder_depth) if reorder_depth > 0
                        else max(2 * self._n, 1))
+        self._retries = max(int(retries), 0)
+        self._backoff = max(float(backoff_s), 0.0)
         self._cv = threading.Condition()
         self._next_claim = 0  # next index a worker will take
         self._next_out = 0  # next index get() delivers
@@ -107,6 +131,7 @@ class InputPipeline:
         self._busy_s = 0.0
         self._waits = 0
         self._wait_s = 0.0
+        self._retry_count = 0
         self._max_depth = 0
         self._t0 = time.perf_counter()
         self._threads = [
@@ -118,6 +143,24 @@ class InputPipeline:
             t.start()
 
     # ------------------------------------------------------------- pool
+    def _attempt(self, i: int) -> dict:
+        """`make_batch(i)` with the shared bounded retry ladder
+        (resilience/healing.py). Purity of make_batch makes a retry
+        deliver the identical batch, so determinism survives transient
+        faults."""
+
+        def make():
+            with obs_trace.span("assemble", index=i):
+                return self._make(i)
+
+        return retry_bounded(make, retries=self._retries,
+                             backoff_s=self._backoff,
+                             on_retry=self._count_retry)
+
+    def _count_retry(self) -> None:
+        with self._cv:
+            self._retry_count += 1
+
     def _worker(self) -> None:
         while True:
             with self._cv:
@@ -130,8 +173,7 @@ class InputPipeline:
                 self._next_claim += 1
             t0 = time.perf_counter()
             try:
-                with obs_trace.span("assemble", index=i):
-                    batch = self._make(i)
+                batch = self._attempt(i)
             except BaseException as e:  # noqa: BLE001 - surfaced on get()
                 with self._cv:
                     if self._exc is None:
@@ -160,8 +202,7 @@ class InputPipeline:
                 self._next_out += 1
             t0 = time.perf_counter()
             try:
-                with obs_trace.span("assemble", index=i):
-                    batch = self._make(i)
+                batch = self._attempt(i)
             except BaseException as e:  # noqa: BLE001 - one idiom for both paths
                 with self._cv:
                     if self._exc is None:
@@ -227,6 +268,7 @@ class InputPipeline:
                 "max_queue_depth": self._max_depth,
                 "waits": self._waits,
                 "wait_s": round(self._wait_s, 4),
+                "retries": self._retry_count,
                 "worker_util": round(self._busy_s / denom, 4),
             }
 

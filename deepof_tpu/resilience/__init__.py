@@ -1,0 +1,42 @@
+"""Fault-tolerance layer (L0 at import — stdlib only).
+
+At production scale, corrupt samples, flaky storage, preemptions, and
+torn checkpoint directories are routine events, not exceptions. This
+package holds the machinery that turns each of them from a run-killer
+into a counted, logged, recoverable event — and the deterministic fault
+injector that *proves* every recovery path in CI instead of hoping.
+
+  faults.py   seeded, config-driven fault injection (`FaultConfig`) at
+              the four operational fault sites: image decode, batch
+              assembly, device dispatch/fetch, checkpoint save/restore
+              — plus post-commit checkpoint tampering (truncation, byte
+              corruption) for the verified-checkpoint chaos tests. Zero
+              overhead when disabled: `build_injector` returns None and
+              every site guards with one `is not None` check.
+  healing.py  self-healing sample assembly: bounded retries with
+              exponential backoff, then quarantine + a deterministic
+              substitute drawn from the same `derive_batch_rng` stream
+              so batch shapes and the rng sequence survive any
+              `num_workers`.
+  verify.py   jax-free checkpoint manifests (pytree structure digest +
+              per-file size/crc32 inventory) and their offline
+              validation (`deepof_tpu verify-ckpt`).
+
+The recovery ladder these pieces implement (cheap rungs first) is
+documented in DESIGN.md "Resilience"; `train/loop.py`,
+`train/checkpoint.py`, `data/pipeline.py`, and `train/metrics_log.py`
+are the wired consumers.
+
+Import discipline: this __init__, faults.py, and verify.py import only
+the stdlib (+numpy in healing.py) so `cli.py verify-ckpt` and
+`analyze.py` never initialize an accelerator backend.
+"""
+
+from .faults import FaultConfig, FaultInjector, InjectedFault, build_injector
+
+__all__ = [
+    "FaultConfig",
+    "FaultInjector",
+    "InjectedFault",
+    "build_injector",
+]

@@ -1,0 +1,166 @@
+"""Self-healing sample assembly: retry, then quarantine + substitute.
+
+The data path's failure modes at scale are (a) transient — a flaky NFS
+read, a storage hiccup, an injected `InjectedFault` — and (b) persistent
+— a corrupt image, a truncated .flo. Today either kind kills the batch
+and, through the input pipeline, the run. `HealingSampler` turns both
+into bounded, counted events:
+
+  transient   bounded retries with exponential backoff. The batch rng is
+              RE-DERIVED per attempt (`make_rng(index, round)` is pure),
+              so a retry reproduces the exact draw the fault interrupted
+              — a run whose faults all recover on retry is bit-identical
+              to a fault-free run at the same seed and `num_workers`
+              (the chaos acceptance pin).
+  persistent  after the retry budget, the draw is QUARANTINED (counted,
+              logged with the failing sample's identity, listed in the
+              run summary) and replaced by a deterministic substitute:
+              the batch is re-drawn from `make_rng(index, round)` with
+              the next round number — the same `derive_batch_rng` stream
+              salted by the substitution round — so the replacement
+              depends only on (stream seed, batch index, round), never
+              on which worker hit the fault or when. Batch shapes and
+              the rng sequence of every OTHER batch index are untouched.
+
+Runs inside the input-pipeline workers (the sampler is called from
+`make_batch(i)`), so healing parallelizes with assembly and a slow
+retry on one index never blocks other workers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+#: Exception types worth retrying: real IO/decode errors (cv2, native
+#: batch IO, filesystem) and injected faults arrive as OSError or
+#: RuntimeError, and CORRUPT payloads as ValueError (io/flo.py raises it
+#: for truncated/garbled .flo data — exactly the persistent per-sample
+#: failure the quarantine path exists for; a code-bug ValueError in the
+#: sample path costs a bounded retry ladder and then surfaces inside
+#: QuarantineError with the original message). Other programming errors
+#: (KeyError, TypeError, ...) surface immediately.
+RETRYABLE = (OSError, RuntimeError, ValueError)
+
+
+def retry_bounded(fn, retries: int = 0, backoff_s: float = 0.0,
+                  on_retry: Callable[[], None] | None = None,
+                  exc_types: tuple = RETRYABLE,
+                  sleep: Callable[[float], None] = time.sleep):
+    """THE retry ladder, shared by every resilience rung (sample draws
+    here, pipeline-worker assembly, metric fetches): up to `retries`
+    re-attempts of `fn()` on `exc_types`, exponential backoff starting
+    at `backoff_s`, `on_retry` called once per re-attempt (counters).
+    One implementation so retry semantics (backoff shape, retryable set)
+    can never silently diverge between sites."""
+    delay = max(float(backoff_s), 0.0)
+    retries = max(int(retries), 0)
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exc_types:
+            if attempt >= retries:
+                raise
+            if on_retry is not None:
+                on_retry()
+            if delay > 0:
+                sleep(delay)
+                delay *= 2
+    raise AssertionError("unreachable")  # loop always returns/raises
+
+
+class QuarantineError(Exception):
+    """Raised when even the substitution rounds are exhausted — every
+    redraw kept failing, which means the data path itself is down (not
+    one bad sample); the run cannot make progress. Deliberately NOT an
+    OSError/RuntimeError: this is the ladder's terminal verdict, and the
+    outer retry layers (pipeline workers, fetchers) must surface it, not
+    re-run the whole exhausted ladder."""
+
+
+class HealingSampler:
+    """Per-batch-index self-healing wrapper around sample assembly.
+
+    make_rng: (index, round) -> rng. Pure; round 0 is the canonical
+        stream (`derive_batch_rng(seed, index)`), rounds >= 1 are the
+        substitute streams (`salt=round`).
+    sample: (index, rng) -> batch dict. May raise RETRYABLE.
+    retries: extra attempts per round after the first (bounded).
+    backoff_s: initial sleep before a retry; doubles per retry.
+    substitutes: quarantine-and-redraw rounds after round 0 fails.
+    injector: optional FaultInjector; consulted at the ``decode`` site
+        once per attempt (inside the retry loop, so injected faults
+        exercise exactly the real-fault recovery path).
+    log: optional str sink (warn records in metrics.jsonl).
+    """
+
+    def __init__(self, make_rng: Callable, sample: Callable,
+                 retries: int = 2, backoff_s: float = 0.05,
+                 substitutes: int = 3, injector=None,
+                 log: Callable[[str], None] | None = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._make_rng = make_rng
+        self._sample = sample
+        self._retries = max(int(retries), 0)
+        self._backoff = max(float(backoff_s), 0.0)
+        self._substitutes = max(int(substitutes), 0)
+        self._inj = injector
+        self._log = log
+        self._sleep = sleep
+        # GIL-atomic int updates (workers call concurrently); quarantine
+        # list appends are likewise single C-level ops
+        self._sample_retries = 0
+        self._quarantined = 0
+        self._substituted = 0
+        self.quarantine_log: list[dict] = []
+
+    def _draw(self, index: int, rnd: int) -> dict:
+        """One attempt: injector's decode site, then the real draw —
+        inside the retry ladder, so injected faults exercise exactly the
+        real-fault recovery path."""
+        if self._inj is not None:
+            self._inj.check("decode", index)
+        return self._sample(index, self._make_rng(index, rnd))
+
+    def _count_retry(self) -> None:
+        self._sample_retries += 1  # GIL-atomic (workers call concurrently)
+
+    def __call__(self, index: int) -> dict:
+        last: BaseException | None = None
+        for rnd in range(self._substitutes + 1):
+            try:
+                batch = retry_bounded(
+                    lambda: self._draw(index, rnd),
+                    retries=self._retries, backoff_s=self._backoff,
+                    on_retry=self._count_retry, sleep=self._sleep)
+            except RETRYABLE as e:
+                # this round's retry budget is spent: quarantine the draw
+                # and fall through to a substitute redraw (next round's rng)
+                last = e
+                self._quarantined += 1
+                ev = {"index": int(index), "round": rnd,
+                      "attempts": self._retries + 1,
+                      "error": f"{type(e).__name__}: {e}"}
+                self.quarantine_log.append(ev)
+                if self._log is not None:
+                    self._log(
+                        f"quarantined sample draw for batch index {index} "
+                        f"(round {rnd}, {self._retries + 1} attempts: "
+                        f"{ev['error']}); substituting a deterministic "
+                        "redraw")
+                continue
+            if rnd > 0:
+                self._substituted += 1
+            return batch
+        raise QuarantineError(
+            f"batch index {index}: all {self._substitutes} substitute "
+            f"redraws failed after quarantine (last: "
+            f"{type(last).__name__}: {last}) — the data path is down, "
+            "not one bad sample") from last
+
+    def stats(self) -> dict[str, int]:
+        """Log/summary-ready counters: retries burned, draws quarantined,
+        substitutes delivered."""
+        return {"sample_retries": self._sample_retries,
+                "quarantined": self._quarantined,
+                "substituted": self._substituted}

@@ -1,4 +1,4 @@
-"""Full train-state checkpointing with auto-resume.
+"""Full train-state checkpointing with auto-resume and verification.
 
 Replaces `tf.train.Saver` model-variables-only checkpoints
 (`flyingChairsTrain.py:156-161,211-213`) with orbax checkpoints of the whole
@@ -6,6 +6,14 @@ TrainState pytree — params + optimizer state + step + PRNG key — so resume
 continues the LR schedule and optimizer moments exactly (fixes the
 reference deficiency in SURVEY.md §5.4). Restore-if-present at startup
 mirrors the reference's `get_checkpoint_state` behavior.
+
+Resilience layer (DESIGN.md "Resilience"): every committed checkpoint
+gets a sibling manifest (pytree-structure digest + per-file size/crc32
+inventory + config digest, resilience/verify.py); `restore` verifies the
+manifest and falls back to the newest checkpoint that validates instead
+of restoring garbage, and save failures (disk full, injected) degrade to
+a logged warning with the previous checkpoint retained — a torn or
+bit-flipped rollback target is a counted event, not a crash.
 """
 
 from __future__ import annotations
@@ -13,17 +21,20 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import warnings
 
 import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from ..resilience import verify as ckpt_verify
 from .state import TrainState
 
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, create: bool = True,
-                 async_save: bool = True):
+                 async_save: bool = True, verify: bool = True,
+                 log=None, injector=None, config_digest: str | None = None):
         """create=False opens read-only (no mkdir side effect — e.g. the
         transfer-init source, where a typo'd path must not leave a phantom
         empty run directory behind).
@@ -33,9 +44,33 @@ class CheckpointManager:
         checkpointing (`ckpt_every_steps`) doesn't stall training on IO.
         Every read path (and the next save) waits for the in-flight write,
         so observable behavior is unchanged; call finalize() before
-        process exit."""
+        process exit.
+
+        verify: validate each candidate's manifest on restore and fall
+        back to the newest checkpoint that verifies (missing manifests —
+        legacy checkpoints — restore unverified).
+        log: optional (step, message) sink for recovery events
+        (MetricsLogger-shaped); warnings.warn when absent — a degraded
+        save or a skipped corrupt checkpoint must never be silent.
+        injector: optional resilience.faults.FaultInjector — consulted at
+        the ckpt_save / ckpt_restore sites and for post-commit tampering
+        (the chaos-test substrate).
+        config_digest: recorded in each manifest; restore warns (but
+        proceeds) on mismatch — fine-tune handoffs legitimately cross
+        configs."""
         self.directory = os.path.abspath(directory)
         self.keep = keep
+        self._verify = verify
+        self._log = log
+        self._inj = injector
+        self._config_digest = config_digest
+        self._pending_manifest: tuple[int, dict] | None = None
+        # recovery-event counters (GIL-atomic int bumps; heartbeat reads)
+        self._saves = 0
+        self._save_failures = 0
+        self._restore_failures = 0
+        self._restore_fallbacks = 0
+        self._verify_failures = 0
         if create:
             os.makedirs(self.directory, exist_ok=True)
         if async_save:
@@ -43,13 +78,79 @@ class CheckpointManager:
         else:
             self._ckpt = ocp.PyTreeCheckpointer()
 
+    # ------------------------------------------------------------- events
+    def _warn(self, step: int, message: str) -> None:
+        if self._log is not None:
+            self._log(step, message)
+        else:
+            warnings.warn(message, RuntimeWarning, stacklevel=3)
+
+    def stats(self) -> dict[str, int]:
+        """Recovery-event counters for train records / heartbeat / the
+        fit summary."""
+        return {"saves": self._saves,
+                "save_failures": self._save_failures,
+                "restore_failures": self._restore_failures,
+                "restore_fallbacks": self._restore_fallbacks,
+                "verify_failures": self._verify_failures}
+
+    # ------------------------------------------------------------ commits
     def _wait(self) -> None:
         wait = getattr(self._ckpt, "wait_until_finished", None)
         if wait is not None:
-            wait()
+            try:
+                wait()
+            except Exception as e:  # noqa: BLE001 - degrade, don't crash
+                # the async WRITE failed (disk full, injected, ...): the
+                # previous checkpoint is still on disk and still the
+                # resume/rollback target — a failed save must not take
+                # the run down with it
+                self._save_failures += 1
+                step = (self._pending_manifest[0]
+                        if self._pending_manifest is not None else -1)
+                self._pending_manifest = None
+                # drop the partial dir (never restorable) — primary only:
+                # directory surgery stays single-writer (see save())
+                if step >= 0 and jax.process_index() == 0:
+                    shutil.rmtree(self._path(step), ignore_errors=True)
+                self._warn(max(step, 0),
+                           f"checkpoint write failed at step {step}: "
+                           f"{type(e).__name__}: {e}; previous checkpoint "
+                           "retained")
+        self._flush_manifest()
+
+    def _flush_manifest(self) -> None:
+        """Write the manifest for the newest COMMITTED save (deferred
+        for async saves: the file inventory is only meaningful once the
+        write has fully committed), then let the injector tamper — after
+        the manifest, so damage is detectable, like real corruption."""
+        if self._pending_manifest is None:
+            return
+        step, structure = self._pending_manifest
+        self._pending_manifest = None
+        path = self._path(step)
+        if not os.path.isdir(path):
+            return  # write never committed (failure handled in _wait)
+        # count COMMITTED checkpoints only: an async write that fails at
+        # _wait never reaches here, so saves/save_failures stay disjoint
+        self._saves += 1
+        if jax.process_index() != 0:
+            return
+        try:
+            manifest = ckpt_verify.build_manifest(
+                path, step, structure=structure,
+                cfg_digest=self._config_digest)
+            ckpt_verify.write_manifest(path, manifest)
+        except OSError as e:
+            self._warn(step, f"checkpoint manifest write failed at step "
+                             f"{step}: {e}; checkpoint restores unverified")
+        if self._inj is not None:
+            for act in self._inj.tamper_checkpoint(step, path):
+                self._warn(step, f"fault injection: {act}")
 
     def finalize(self) -> None:
-        """Block until any in-flight async save has fully committed."""
+        """Block until any in-flight async save has fully committed (and
+        its manifest is flushed)."""
         self._wait()
 
     def _path(self, step: int) -> str:
@@ -71,7 +172,26 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, state: TrainState) -> str:
+    @staticmethod
+    def _structure_digest(state) -> dict:
+        """Pytree-structure digest: leaf paths + shapes + dtypes (no
+        value reads — the content checksum is the manifest's per-file
+        crc inventory over the committed bytes)."""
+        import zlib
+
+        leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        crc = 0
+        for keypath, leaf in leaves:
+            spec = (f"{jax.tree_util.keystr(keypath)}:"
+                    f"{getattr(leaf, 'shape', ())}:"
+                    f"{getattr(leaf, 'dtype', type(leaf).__name__)};")
+            crc = zlib.crc32(spec.encode(), crc)
+        return {"num_leaves": len(leaves), "crc32": crc}
+
+    def save(self, state: TrainState) -> str | None:
+        """Write a checkpoint; on failure (disk full, injected fault),
+        degrade to a logged warning and return None — the previous
+        checkpoint stays the resume/rollback target."""
         step = int(jax.device_get(state.step))
         self._wait()  # serialize with any still-writing previous save
         path = self._path(step)
@@ -79,30 +199,131 @@ class CheckpointManager:
         # directory surgery (clobber + prune) must be single-writer or one
         # host can rmtree a directory another host's writer is mid-write to.
         primary = jax.process_index() == 0
-        if primary:
-            if os.path.exists(path):
-                shutil.rmtree(path)
-            # Prune BEFORE the (possibly async) write, but always retain
-            # the newest completed checkpoint: if the in-flight write never
-            # commits (crash, disk full), a restorable state must survive.
-            # keep=1 therefore transiently holds 2 checkpoints on disk.
-            done = self.all_steps()  # _wait() already ran above
-            for old in done[: -max(self.keep - 1, 1)]:
-                if old != step:
-                    shutil.rmtree(self._path(old), ignore_errors=True)
-        self._ckpt.save(path, state)
+        started = False  # the write itself began (vs a pre-write failure)
+        try:
+            if self._inj is not None:
+                self._inj.check("ckpt_save", step)
+            if primary:
+                if os.path.exists(path):
+                    shutil.rmtree(path)
+                    self._rm_manifest(step)
+                # Prune BEFORE the (possibly async) write, but always retain
+                # the newest completed checkpoint: if the in-flight write never
+                # commits (crash, disk full), a restorable state must survive.
+                # keep=1 therefore transiently holds 2 checkpoints on disk.
+                done = self.all_steps()  # _wait() already ran above
+                for old in done[: -max(self.keep - 1, 1)]:
+                    if old != step:
+                        shutil.rmtree(self._path(old), ignore_errors=True)
+                        self._rm_manifest(old)
+            started = True
+            self._ckpt.save(path, state)
+        except Exception as e:  # noqa: BLE001 - degrade, don't crash
+            self._save_failures += 1
+            # remove the partial dir ONLY if the write began: a failure
+            # before that (e.g. an injected pre-write fault on a re-save)
+            # must not delete a previously COMMITTED checkpoint at this
+            # step. Single-writer directory surgery (see above).
+            if primary and started:
+                shutil.rmtree(path, ignore_errors=True)
+                self._rm_manifest(step)
+            self._warn(step,
+                       f"checkpoint save failed at step {step}: "
+                       f"{type(e).__name__}: {e}; previous checkpoint "
+                       "retained")
+            return None
+        # manifest deferred until the write has COMMITTED: flushed by the
+        # next _wait() (any read path / next save / finalize); sync
+        # checkpointers have committed already, flush now
+        self._pending_manifest = (step, self._structure_digest(state))
+        if not hasattr(self._ckpt, "wait_until_finished"):
+            self._flush_manifest()
         return path
+
+    def _rm_manifest(self, step: int) -> None:
+        try:
+            os.remove(ckpt_verify.manifest_path(self._path(step)))
+        except OSError:
+            pass
+
+    def _verify_candidate(self, step: int,
+                          expect_structure: dict | None = None) -> list[str]:
+        """Problems blocking a restore of `step` ([] = restorable).
+        A missing manifest (legacy checkpoint, or a crash between commit
+        and manifest flush) restores unverified — absence is not
+        corruption. `expect_structure` (the restore template's pytree
+        digest) catches the files-intact-but-wrong-tree case before
+        orbax does anything with it."""
+        if not self._verify:
+            return []
+        path = self._path(step)
+        manifest = ckpt_verify.load_manifest(ckpt_verify.manifest_path(path))
+        if manifest is None:
+            return []
+        problems = ckpt_verify.verify_files(path, manifest)
+        saved = manifest.get("structure")
+        if not problems and saved and expect_structure is not None:
+            if (saved.get("num_leaves") != expect_structure["num_leaves"]
+                    or saved.get("crc32") != expect_structure["crc32"]):
+                problems = [
+                    f"pytree structure mismatch (checkpoint {saved} != "
+                    f"restore template {expect_structure})"]
+        if not problems:
+            digest = manifest.get("config_digest")
+            if (digest and self._config_digest
+                    and digest != self._config_digest):
+                self._warn(step,
+                           f"checkpoint step {step} was written by a "
+                           f"different config (digest {digest} != "
+                           f"{self._config_digest}); restoring anyway")
+        return problems
 
     def restore(self, template: TrainState, step: int | None = None) -> TrainState | None:
         """Restore into the structure of `template` (shapes/dtypes/shardings
         come from the abstract template, the non-pytree `tx` is carried
-        over). Returns None if no checkpoint exists."""
+        over). Returns None if no checkpoint exists.
+
+        With verification on (ResilienceConfig.verify_checkpoints), a
+        candidate whose manifest fails — or whose orbax read raises — is
+        skipped with a logged warning and the next-newest checkpoint is
+        tried: auto-resume and NaN rollback land on the newest VALID
+        state instead of crashing into (or silently loading) a torn one.
+        An explicit `step` restores only that step (None on failure)."""
         self._wait()
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
-        restored = self._ckpt.restore(self._path(step), item=template)
-        return restored.replace(tx=template.tx)
+        candidates = ([step] if step is not None
+                      else list(reversed(self.all_steps())))
+        expect = self._structure_digest(template) if self._verify else None
+        for i, s in enumerate(candidates):
+            problems = self._verify_candidate(s, expect)
+            if problems:
+                self._verify_failures += 1
+                self._warn(s,
+                           f"checkpoint step {s} failed verification "
+                           f"({'; '.join(problems[:3])}); "
+                           + ("trying an older checkpoint"
+                              if i + 1 < len(candidates)
+                              else "no older checkpoint to fall back to"))
+                continue
+            try:
+                if self._inj is not None:
+                    self._inj.check("ckpt_restore", s)
+                restored = self._ckpt.restore(self._path(s), item=template)
+            except Exception as e:  # noqa: BLE001 - fall back, don't crash
+                self._restore_failures += 1
+                self._warn(s,
+                           f"checkpoint restore failed at step {s}: "
+                           f"{type(e).__name__}: {e}; "
+                           + ("trying an older checkpoint"
+                              if i + 1 < len(candidates)
+                              else "no older checkpoint to fall back to"))
+                continue
+            if i > 0:
+                self._restore_fallbacks += 1
+                self._warn(s,
+                           f"restored fallback checkpoint step {s} "
+                           f"({i} newer checkpoint(s) skipped as invalid)")
+            return restored.replace(tx=template.tx)
+        return None
 
     def restore_raw(self, step: int | None = None,
                     subtree: str | None = None) -> dict | None:

@@ -12,6 +12,7 @@ NaN handling upgrades the reference's crash-on-NaN assert
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal
 import time
@@ -32,6 +33,9 @@ from ..obs.telemetry import (
     step_flops,
 )
 from ..parallel.mesh import batch_sharding, build_mesh
+from ..resilience.faults import build_injector
+from ..resilience.healing import HealingSampler
+from ..resilience.verify import config_digest
 from .checkpoint import CheckpointManager
 from .evaluate import evaluate_aee, evaluate_ucf101
 from .metrics_log import (
@@ -61,6 +65,20 @@ _EARLY_SIGTERM: dict = {"sig": None, "handler": None}
 # A prefetch.get() wait above this is counted as a `starved` step (the
 # device had no staged batch to eat); below it is queue-handoff noise.
 STARVED_WAIT_S = 1e-3
+
+
+def _poison_batch(batch: dict) -> dict:
+    """Dispatch-site fault action: one NaN in the first float input
+    tensor. The batch may already be device-resident and sharded (the
+    prefetcher staged it); the functional `.at[].set` keeps it there."""
+    out = dict(batch)
+    for key in ("volume", "source", *batch):
+        if key in out and jnp.issubdtype(
+                jnp.asarray(out[key]).dtype, jnp.floating):
+            arr = jnp.asarray(out[key])
+            out[key] = arr.at[(0,) * arr.ndim].set(jnp.nan)
+            return out
+    return out
 
 
 def install_preemption_latch() -> None:
@@ -149,8 +167,22 @@ class Trainer:
             self.model, _example_input(cfg), tx, seed=cfg.train.seed,
             log=lambda m: self.logger.log("info", 0, message=m))
 
-        self.ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt",
-                                      keep=cfg.train.keep_ckpts)
+        # Deterministic fault injector (resilience/faults.py): None when
+        # disabled — every site below guards on one `is not None`, the
+        # zero-overhead contract. One injector is shared by the data
+        # path, the fetchers, and the checkpoint manager so per-site
+        # attempt counting is globally consistent.
+        self._inj = build_injector(cfg.resilience.faults)
+        if self._inj is not None:
+            self.logger.log("warn", 0,
+                            message="fault injection ENABLED "
+                                    f"({cfg.resilience.faults})")
+        self.ckpt = CheckpointManager(
+            cfg.train.log_dir + "/ckpt", keep=cfg.train.keep_ckpts,
+            verify=cfg.resilience.verify_checkpoints,
+            log=lambda s, m: self.logger.log("warn", s, message=m),
+            injector=self._inj,
+            config_digest=config_digest(dataclasses.asdict(cfg)))
         # VGG16 pretrained conv-trunk init (`flyingChairsTrain.py:60-76`);
         # fresh starts only — a checkpoint to resume from takes precedence.
         _vgg_trunks = {"vgg16": ("encoder",), "st_single": ("encoder",),
@@ -191,6 +223,18 @@ class Trainer:
             self.state = restored
             self.logger.log("info", int(self.state.step),
                             message=f"resumed from step {int(self.state.step)}")
+        elif self.ckpt.latest_step() is not None:
+            # checkpoints EXIST but none is restorable (every candidate
+            # failed verification/restore): silently starting from step 0
+            # would clobber/prune a damaged run's directory and hide the
+            # corruption — refuse, with the diagnosis command
+            raise RuntimeError(
+                f"auto-resume: checkpoints exist under {self.ckpt.directory} "
+                "but none is restorable (all candidates failed "
+                "verification/restore); refusing to silently restart from "
+                "scratch — run `deepof_tpu verify-ckpt "
+                f"{cfg.train.log_dir}` for per-checkpoint status, then move "
+                "the ckpt directory aside to intentionally start fresh")
 
         # Sharded eval requires eval_batch_size % data-axis size == 0; adjust
         # to the nearest multiple (minimum one sample per shard) rather than
@@ -291,6 +335,26 @@ class Trainer:
         self.enable_augmentation()
         start_step = int(self.state.step)
         seed_arr = data_stream_seed(self.mesh, cfg.train.seed, start_step)
+        inj = self._inj
+        # Self-healing data path (resilience/healing.py): per micro-batch
+        # index, bounded retries with backoff — the rng is RE-DERIVED per
+        # attempt, so a recovered transient fault yields the bit-identical
+        # batch — then quarantine + a deterministic substitute drawn from
+        # the same derive_batch_rng stream (salt = redraw round). Runs
+        # inside the pipeline workers, so healing parallelizes with
+        # assembly for any `num_workers`.
+        # warn records from the healer (worker threads, possibly a few
+        # batches ahead of the loop) stamp the loop's CURRENT step — an
+        # approximate but live timeline, not the fit's start step
+        cur_step = {"s": start_step}
+        healer = HealingSampler(
+            make_rng=lambda i, rnd: derive_batch_rng(seed_arr, i, salt=rnd),
+            sample=self._next_train_batch,
+            retries=cfg.resilience.data_retries,
+            backoff_s=cfg.resilience.data_backoff_s,
+            substitutes=cfg.resilience.data_substitutes,
+            injector=inj,
+            log=lambda m: self.logger.log("warn", cur_step["s"], message=m))
         k = max(cfg.train.steps_per_call, 1)
         if k == 1:
             sharding = batch_sharding(self.mesh)
@@ -318,15 +382,16 @@ class Trainer:
             augmentation, and the K-stack all happen off the main
             thread. A NaN rollback resumes dispatching from the next
             unconsumed index (the stream continues forward, exactly like
-            the pre-pipeline sequential rng did)."""
+            the pre-pipeline sequential rng did). Sample draws go
+            through the HealingSampler (retry/quarantine/substitute);
+            the `assemble` injection site sits above it so an injected
+            assembly fault exercises the pipeline-worker retry path."""
+            if inj is not None:
+                inj.check("assemble", call_idx)
             if k == 1:
-                return self._next_train_batch(
-                    call_idx, derive_batch_rng(seed_arr, call_idx))
+                return healer(call_idx)
             # steps_per_call: K batches stacked on a leading scan axis
-            bs = [
-                self._next_train_batch(i, derive_batch_rng(seed_arr, i))
-                for i in range(call_idx * k, call_idx * k + k)
-            ]
+            bs = [healer(i) for i in range(call_idx * k, call_idx * k + k)]
             return {key: _stack([b[key] for b in bs]) for key in bs[0]}
 
         # --- Observability (DESIGN.md "Observability") ---
@@ -356,7 +421,9 @@ class Trainer:
         # decode/augment/stack out-of-order, delivery stays in index
         # order through the bounded reorder buffer.
         pipeline = InputPipeline(assemble, num_workers=cfg.data.num_workers,
-                                 reorder_depth=cfg.data.reorder_depth)
+                                 reorder_depth=cfg.data.reorder_depth,
+                                 retries=cfg.resilience.pipeline_retries,
+                                 backoff_s=cfg.resilience.data_backoff_s)
         # stage=True: the next (super-)batch is transferred AND resident
         # on device while the current call's scan executes, its wait spent
         # on the prefetch thread and accounted as the `put` phase. The
@@ -377,14 +444,35 @@ class Trainer:
         # bounded queue blocks dispatch at `depth` in-flight calls,
         # keeping host progress honest. depth 0 = serial fetch inline.
         depth = max(cfg.train.pipeline_depth, 0)
+        fetch_kw = dict(timer=timer, retries=cfg.resilience.fetch_retries,
+                        backoff_s=cfg.resilience.data_backoff_s, injector=inj)
         try:
-            fetcher = (AsyncFetcher(depth=depth, timer=timer) if depth > 0
-                       else SyncFetcher(timer=timer))
+            fetcher = (AsyncFetcher(depth=depth, **fetch_kw) if depth > 0
+                       else SyncFetcher(**fetch_kw))
         except BaseException:  # same leak guard as the Prefetcher above
             pipeline.close()
             prefetch.close()
             _obs_teardown()
             raise
+
+        def resilience_stats() -> dict:
+            """ONE source for the prefixed data-path/fetcher/ckpt/fault
+            counter merge — the heartbeat sample, every periodic train
+            record, and the fit summary all call this, so the three
+            surfaces can never drift apart."""
+            return {**{f"data_{sk}": sv
+                       for sk, sv in pipeline.stats().items()},
+                    **{f"data_{sk}": sv
+                       for sk, sv in prefetch.stats().items()},
+                    **{f"data_{sk}": sv
+                       for sk, sv in healer.stats().items()},
+                    **{f"pipeline_{sk}": sv
+                       for sk, sv in fetcher.stats().items()},
+                    **{f"ckpt_{sk}": sv
+                       for sk, sv in self.ckpt.stats().items()},
+                    **({f"fault_{sk}": sv
+                        for sk, sv in inj.stats().items()}
+                       if inj is not None else {})}
         # Liveness heartbeat + wedge watchdog (obs/heartbeat.py): a
         # background thread atomically rewrites heartbeat.json with
         # step/rates/depths/device-memory/RSS, and dumps every thread's
@@ -396,11 +484,12 @@ class Trainer:
         if cfg.obs.heartbeat and primary:
 
             def _hb_sample() -> dict:
-                return {**timer.rates(),
-                        **{f"data_{dk}": dv
-                           for dk, dv in pipeline.stats().items()},
-                        **{f"data_{dk}": dv
-                           for dk, dv in prefetch.stats().items()}}
+                # resilience counters ride along (skipped_updates /
+                # rollbacks via timer.counters(), quarantine/retry/
+                # fallback via resilience_stats) so `deepof_tpu tail`
+                # sees recovery activity even between train records
+                return {**timer.rates(), **timer.counters(),
+                        **resilience_stats()}
 
             try:
                 heartbeat = Heartbeat(
@@ -423,6 +512,12 @@ class Trainer:
         # the checkpoint restore, so divergence handling is unchanged).
         nan_event: dict = {"m": None}
         streak = {"ok": False}  # a fetched finite step resets the NaN streak
+        # Divergence-ladder rung-1 state (DESIGN.md "Resilience"): the
+        # step function skips non-finite updates in place; the observed
+        # skip streak escalates to a rollback only at
+        # resilience.max_consecutive_skips. Counted at fetch granularity
+        # (metrics are only host-visible at log/eval/ckpt boundaries).
+        skip_state = {"streak": 0}
         last_eval: dict[str, float] = {}
         # Preemption-graceful stop (SURVEY.md §5.3): TPU pods get SIGTERM
         # before eviction; the reference dies losing everything since its
@@ -481,15 +576,50 @@ class Trainer:
                 return float(a) if a.ndim == 0 else float(a[-1])
 
             def _on_metrics(tag, m_host):
-                """Fetch-completion consumer: NaN triage + the train log
-                record. Runs on the fetcher thread (or inline at depth 0)
-                once the device values for `tag`'s step have ARRIVED —
-                the honest value-fetch clock (DESIGN.md)."""
+                """Fetch-completion consumer: divergence triage + the
+                train log record. Runs on the fetcher thread (or inline
+                at depth 0) once the device values for `tag`'s step have
+                ARRIVED — the honest value-fetch clock (DESIGN.md).
+
+                The graduated ladder: updates the step fn already
+                skipped in place (`update_skipped`) cost nothing beyond
+                a counter until the skip streak hits
+                resilience.max_consecutive_skips — then escalate to the
+                checkpoint rollback. A non-finite loss whose update was
+                NOT skipped means divergence reached the state: roll
+                back immediately (the pre-ladder behavior)."""
                 gs, ep, log_due_ = tag
-                if cfg.train.nan_guard and not np.isfinite(
-                        np.asarray(m_host["total"])).all():
+                skipped = 0
+                if "update_skipped" in m_host:
+                    skipped = int(round(float(
+                        np.asarray(m_host["update_skipped"]).sum())))
+                if skipped:
+                    timer.count("skipped_updates", skipped)
+                    skip_state["streak"] += skipped
+                    self.logger.log(
+                        "warn", gs,
+                        message=f"non-finite grads at step {gs}: "
+                                f"{skipped} update(s) skipped in place "
+                                f"(state unchanged; streak "
+                                f"{skip_state['streak']}/"
+                                f"{cfg.resilience.max_consecutive_skips})")
+                nonfinite = cfg.train.nan_guard and not np.isfinite(
+                    np.asarray(m_host["total"])).all()
+                if nonfinite and not skipped:
                     nan_event["m"] = (gs, m_host)
                     return  # never log a diverged record
+                if (skipped and cfg.train.nan_guard
+                        and skip_state["streak"] >= max(
+                            cfg.resilience.max_consecutive_skips, 1)):
+                    # escalate skip->rollback — rollback is nan_guard
+                    # machinery, so nan_guard=false keeps its pre-ladder
+                    # meaning: count skips, never roll back or abort
+                    nan_event["m"] = (gs, m_host)
+                    return
+                if not skipped:
+                    skip_state["streak"] = 0
+                if nonfinite:
+                    return  # skipped in place: state clean, record isn't
                 streak["ok"] = True
                 if log_due_:
                     # input-side observability travels with every train
@@ -510,11 +640,7 @@ class Trainer:
                         **{key: _scalar_last(v) for key, v in m_host.items()
                            if key in ("action_loss", "accuracy")},
                         **timer.rates(), **timer.phases(),
-                        **timer.counters(),
-                        **{f"data_{dk}": dv
-                           for dk, dv in pipeline.stats().items()},
-                        **{f"data_{dk}": dv
-                           for dk, dv in prefetch.stats().items()},
+                        **timer.counters(), **resilience_stats(),
                         **cache_kw, **self._telemetry(timer))
 
             gstep = start_step
@@ -532,6 +658,23 @@ class Trainer:
                     # thread (and so the next dispatch) measurably
                     # waited on the host input side
                     timer.count("starved")
+                if inj is not None:
+                    # dispatch-site fault: poison the staged batch with
+                    # one NaN — the deterministic stand-in for "the
+                    # device produced non-finite grads at this step",
+                    # exercising the skip-in-place rung end to end. The
+                    # whole dispatched window [gstep, gstep+k) is checked
+                    # so a scheduled step inside a steps_per_call stride
+                    # still fires (the poison lands in the first
+                    # micro-batch — the skip ladder doesn't care which).
+                    hits = [s for s in range(gstep, gstep + k)
+                            if inj.hit("dispatch", s)]
+                    if hits:
+                        batch = _poison_batch(batch)
+                        self.logger.log(
+                            "warn", gstep,
+                            message=f"fault injection: dispatch batch at "
+                                    f"step(s) {hits} poisoned with NaN")
                 t0 = time.perf_counter()
                 if first_step:  # XLA compile-time report (SURVEY.md §5.1)
                     cache_watch = cache_delta()
@@ -564,6 +707,7 @@ class Trainer:
                 timer.phase("dispatch", time.perf_counter() - t0)
                 timer.tick(k)
                 prev, gstep = gstep, gstep + k
+                cur_step["s"] = gstep  # live step for healer warn records
                 if heartbeat is not None:
                     heartbeat.beat(gstep)
                 epoch = gstep // self.steps_per_epoch
@@ -604,6 +748,8 @@ class Trainer:
                     nan_step, _ = nan_event["m"]
                     nan_event["m"] = None
                     streak["ok"] = False
+                    skip_state["streak"] = 0  # the rollback rewinds the run
+                    timer.count("rollbacks")
                     self._rollback(nan_step)
                     gstep = int(self.state.step)
                     # discarded steps must not count toward throughput
@@ -634,12 +780,31 @@ class Trainer:
                         heartbeat.touch()  # a long sweep is not a wedge
                 if ckpt_due:
                     with obs_trace.span("ckpt", step=gstep):
-                        self.ckpt.save(self.state)
-                    ckpt_mark = timer.mark()
+                        saved = self.ckpt.save(self.state)
+                    if saved is not None:
+                        # a DEGRADED save (disk full, injected) keeps the
+                        # previous mark: a later rollback restores the
+                        # last checkpoint actually written, and rewind
+                        # must discard exactly the steps that restore
+                        # discards — not just those since the failed save
+                        ckpt_mark = timer.mark()
                     timer.pause()
                     if heartbeat is not None:
                         heartbeat.touch()
             self.profiler.maybe_stop()
+            if healer.quarantine_log:
+                # the run summary's quarantine listing: one info record
+                # naming every quarantined draw (index, round, error) —
+                # the per-event warn records carry the live timeline,
+                # this is the roll-up an operator greps for
+                self.logger.log(
+                    "info", gstep,
+                    message=f"{len(healer.quarantine_log)} sample draw(s) "
+                            "quarantined and substituted this run: "
+                            + "; ".join(
+                                f"batch {ev['index']} round {ev['round']} "
+                                f"({ev['error']})"
+                                for ev in healer.quarantine_log[:20]))
             # all in-flight NaN checks land before finalize — but bounded:
             # a consumer wedged in a dead-tunnel device_get must not hang
             # this path away from the finally's close()/ckpt.finalize()
@@ -664,6 +829,15 @@ class Trainer:
             if final_ok and cfg.train.nan_guard and metrics is not None:
                 total = np.asarray(jax.device_get(metrics["total"]))
                 final_ok = bool(np.isfinite(total).all())
+                if not final_ok and "update_skipped" in metrics:
+                    # a non-finite final loss whose update(s) the step fn
+                    # skipped IN PLACE never reached the state — the
+                    # state is clean and saving it is correct (rolling
+                    # back would discard good steps for nothing)
+                    sk = np.atleast_1d(np.asarray(
+                        jax.device_get(metrics["update_skipped"])))
+                    bad = ~np.isfinite(np.atleast_1d(total))
+                    final_ok = bool(np.all(sk[bad] >= 0.5))
             if final_ok:
                 self.ckpt.save(self.state)
             elif not drained:
@@ -725,9 +899,12 @@ class Trainer:
         # batch assembly (starved / data_* worker stats).
         return {**last_eval, **timer.rates(), **timer.phases(),
                 **timer.counters(),
-                **{f"pipeline_{k}": v for k, v in fetcher.stats().items()},
-                **{f"data_{k}": v for k, v in pipeline.stats().items()},
-                **{f"data_{k}": v for k, v in prefetch.stats().items()},
+                # resilience roll-up rides along (quarantine/retry/
+                # substitute, checkpoint recovery events, fault_* when
+                # injection is on) — every recovery event is visible in
+                # the one-line run summary, from the same merge the
+                # heartbeat and train records use
+                **resilience_stats(),
                 # telemetry (model_tflops/mfu_nominal/dev mem/rss);
                 # None-valued fields dropped — the summary stays
                 # float()-able for CLI printing
@@ -755,10 +932,19 @@ class Trainer:
         with obs_trace.span("rollback", step=step):
             restored = self.ckpt.restore(self.state)
             if restored is None:
+                # no RESTORABLE checkpoint: either none was ever written
+                # or every candidate failed verification/restore.
+                # Proceeding would keep training on the diverged state —
+                # fail with the one fact the operator needs (where the
+                # checkpoints should be / what's in that dir).
                 raise FloatingPointError(
-                    f"loss diverged to NaN at step {step} "
-                    "with no checkpoint to roll back to")
+                    f"divergence at step {step} and no restorable "
+                    f"checkpoint under {self.ckpt.directory} to roll back "
+                    "to (none written yet, or every candidate failed "
+                    "verification — run `deepof_tpu verify-ckpt "
+                    f"{os.path.dirname(self.ckpt.directory)}` to see "
+                    "per-checkpoint status)")
             self.state = restored
         self.logger.log("warn", step,
-                        message=f"NaN at step {step}; rolled back to "
-                                f"step {int(restored.step)}")
+                        message=f"divergence at step {step}; rolled back "
+                                f"to step {int(restored.step)}")

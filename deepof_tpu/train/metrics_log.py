@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from ..obs import trace as obs_trace
+from ..resilience.healing import retry_bounded
 
 
 def _scalarize(v):
@@ -176,6 +177,24 @@ class StepTimer:
         self._last = None
 
 
+def _fetch_with_retry(fetch, tree, seq: int, retries: int, backoff_s: float,
+                      injector, count_retry) -> dict:
+    """Device->host value fetch on the shared bounded retry ladder
+    (resilience/healing.py): a transient transport error — the
+    tunneled-RTT failure mode this repo's fetchers exist for, or an
+    injected `fetch` fault — is retried with exponential backoff instead
+    of dooming the run at the next submit/drain. `seq` keys injection
+    deterministically (fetch consumption order == submit order)."""
+
+    def once():
+        if injector is not None:
+            injector.check("fetch", seq)
+        return fetch(tree)
+
+    return retry_bounded(once, retries=retries, backoff_s=backoff_s,
+                         on_retry=count_retry)
+
+
 class AsyncFetcher:
     """Bounded-depth background drain of device metric values.
 
@@ -200,9 +219,16 @@ class AsyncFetcher:
 
     _STOP = object()
 
-    def __init__(self, depth: int = 2, fetch_fn=None, timer: StepTimer | None = None):
+    def __init__(self, depth: int = 2, fetch_fn=None,
+                 timer: StepTimer | None = None, retries: int = 0,
+                 backoff_s: float = 0.05, injector=None):
         self._fetch = fetch_fn if fetch_fn is not None else jax.device_get
         self._timer = timer
+        self._retries = max(int(retries), 0)
+        self._backoff = max(float(backoff_s), 0.0)
+        self._inj = injector
+        self._retry_count = 0
+        self._seq = 0  # fetches consumed, = submit order (FIFO queue)
         self._depth = max(depth, 1)
         self._q: queue.Queue = queue.Queue()  # unbounded; _cv is the bound
         self._exc: BaseException | None = None
@@ -223,9 +249,12 @@ class AsyncFetcher:
                 return
             tag, tree, callback = item
             try:
+                seq, self._seq = self._seq, self._seq + 1
                 t0 = time.perf_counter()
                 with obs_trace.span("fetch"):
-                    host = self._fetch(tree)
+                    host = _fetch_with_retry(self._fetch, tree, seq,
+                                             self._retries, self._backoff,
+                                             self._inj, self._count_retry)
                 dt = time.perf_counter() - t0
                 with self._cv:
                     self._fetches += 1
@@ -281,10 +310,14 @@ class AsyncFetcher:
         self._raise_pending()
         return True
 
+    def _count_retry(self) -> None:
+        self._retry_count += 1  # GIL-atomic; read by stats()
+
     def stats(self) -> dict[str, float]:
         with self._cv:
             return {"fetches": self._fetches,
                     "fetch_s": round(self._fetch_s, 4),
+                    "fetch_retries": self._retry_count,
                     "max_in_flight": self._max_in_flight}
 
     def close(self) -> None:
@@ -302,16 +335,26 @@ class SyncFetcher:
     `TrainConfig.pipeline_depth = 0`). Same interface as AsyncFetcher so
     the train loop has one code path."""
 
-    def __init__(self, fetch_fn=None, timer: StepTimer | None = None):
+    def __init__(self, fetch_fn=None, timer: StepTimer | None = None,
+                 retries: int = 0, backoff_s: float = 0.05, injector=None):
         self._fetch = fetch_fn if fetch_fn is not None else jax.device_get
         self._timer = timer
+        self._retries = max(int(retries), 0)
+        self._backoff = max(float(backoff_s), 0.0)
+        self._inj = injector
+        self._retry_count = 0
         self._fetches = 0
         self._fetch_s = 0.0
+
+    def _count_retry(self) -> None:
+        self._retry_count += 1
 
     def submit(self, tag, tree, callback) -> None:
         t0 = time.perf_counter()
         with obs_trace.span("fetch"):
-            host = self._fetch(tree)
+            host = _fetch_with_retry(self._fetch, tree, self._fetches,
+                                     self._retries, self._backoff,
+                                     self._inj, self._count_retry)
         dt = time.perf_counter() - t0
         self._fetches += 1
         self._fetch_s += dt
@@ -324,6 +367,7 @@ class SyncFetcher:
 
     def stats(self) -> dict[str, float]:
         return {"fetches": self._fetches, "fetch_s": round(self._fetch_s, 4),
+                "fetch_retries": self._retry_count,
                 "max_in_flight": 1 if self._fetches else 0}
 
     def close(self) -> None:

@@ -167,8 +167,28 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
             return total, aux
 
         (total, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        new_state = state.apply_gradients(grads).replace(rng=rng)
-        metrics = {"total": total, "grad_norm": optax.global_norm(grads)}
+        grad_norm = optax.global_norm(grads)
+        if cfg.resilience.skip_nonfinite:
+            # Divergence-ladder rung 1 (DESIGN.md "Resilience"): detect
+            # non-finite loss/grads BEFORE the update and skip it in
+            # place — params, opt_state, and step stay exactly the
+            # previous state's (rng still advances so a retried batch
+            # doesn't replay the same dropout draw), and the host sees
+            # `update_skipped` per inner step. One bad batch then costs
+            # one skipped update, not a checkpoint rollback. The select
+            # is a no-op bitwise when finite: jnp.where(True, new, old)
+            # returns `new` exactly.
+            finite = jnp.isfinite(total) & jnp.isfinite(grad_norm)
+            applied = state.apply_gradients(grads).replace(rng=rng)
+            kept = state.replace(rng=rng)
+            new_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(finite, n, o), applied, kept)
+            skipped = 1.0 - finite.astype(jnp.float32)
+        else:
+            new_state = state.apply_gradients(grads).replace(rng=rng)
+            skipped = jnp.float32(0.0)
+        metrics = {"total": total, "grad_norm": grad_norm,
+                   "update_skipped": skipped}
         if "losses" in aux:
             for key in ("total", "Charbonnier_reconstruct", "U_loss", "V_loss"):
                 metrics[f"scale_{key}"] = jnp.stack([d[key] for d in aux["losses"]])

@@ -1,0 +1,64 @@
+"""Subprocess half of the second-SIGTERM escalation test
+(tests/test_resilience.py::test_second_sigterm_falls_through).
+
+Runs a real Trainer.fit() on the synthetic dataset with the train step
+wrapped so the SECOND dispatch blocks on a long main-thread sleep —
+a deterministic stand-in for a run wedged somewhere the stop flag is
+never polled. The parent waits for the WEDGED line, then sends SIGTERM
+twice: the first is absorbed by fit()'s graceful handler (stop flag
+only — the wedged loop never reaches the next boundary), the second
+must fall through to the default action and kill the process with
+SIGTERM (rc == -15), proving a wedged run stays killable without an
+operator SIGKILL.
+
+Run in a SUBPROCESS (not in-suite) for two reasons: signal handlers
+only install in a main thread, and an in-process fit under the suite's
+process-wide warm compile cache hits the known cpu cache-read heap
+corruption (hostmesh.py r07 addendum) — same rationale as
+tests/test_obs.py's CLI fit test.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepof_tpu.core.config import (  # noqa: E402
+    DataConfig,
+    ExperimentConfig,
+    TrainConfig,
+)
+from deepof_tpu.train.loop import Trainer  # noqa: E402
+
+
+def main() -> None:
+    log_dir = sys.argv[1]
+    cfg = ExperimentConfig(
+        model="flownet_s",
+        width_mult=0.25,
+        data=DataConfig(dataset="synthetic", image_size=(64, 64),
+                        gt_size=(64, 64), batch_size=8),
+        train=TrainConfig(num_epochs=10**6, log_every=1, eval_every=0,
+                          ckpt_every_epochs=10**6, log_dir=log_dir,
+                          eval_batch_size=8, eval_amplifier=1.0, seed=0))
+    trainer = Trainer(cfg)
+    real_step = trainer.train_step
+    calls = {"n": 0}
+
+    def wedged_step(state, batch):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            # main-thread wedge: fit()'s handler still runs (signals are
+            # delivered between bytecodes; CPython resumes the sleep),
+            # but the loop never reaches its stop_sig check
+            print("WEDGED", flush=True)
+            time.sleep(600)
+        return real_step(state, batch)
+
+    trainer.train_step = wedged_step
+    trainer.fit(num_epochs=1, max_steps=10**6)
+
+
+if __name__ == "__main__":
+    main()
