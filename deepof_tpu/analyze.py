@@ -109,6 +109,14 @@ def _resilience_counters(rec: dict) -> dict:
     return out
 
 
+def _serve_counters(rec: dict) -> dict:
+    """`serve_*` counters from one record or heartbeat sample (the
+    serving subsystem's block: requests/responses/errors, batch
+    occupancy, latency percentiles, queue depths)."""
+    return {k[len("serve_"):]: v for k, v in rec.items()
+            if k.startswith("serve_") and v is not None}
+
+
 def summarize(records: list[dict]) -> dict:
     by_kind: dict[str, list[dict]] = defaultdict(list)
     for r in records:
@@ -157,6 +165,12 @@ def summarize(records: list[dict]) -> dict:
         best = max(accs, key=lambda r: r["accuracy"])
         out["accuracy"] = {"last": accs[-1]["accuracy"],
                           "best": best["accuracy"], "best_step": best["step"]}
+
+    serves = by_kind.get("serve", [])
+    if serves:
+        # cumulative counters: the newest serve record carries the whole
+        # serving session (server.py appends one at shutdown)
+        out["serve"] = _serve_counters(serves[-1])
 
     warns = by_kind.get("warn", [])
     if warns:
@@ -262,6 +276,15 @@ def tail_summary(log_dir: str, recent: int = 10,
         res = {**out.get("resilience", {}), **_resilience_counters(hb)}
         if res:
             out["resilience"] = res
+        # a serving process's heartbeat carries the live serve_* block
+        # (queue depth, occupancy, p50/p99 latency, requests/s)
+        serve = _serve_counters(hb)
+        if serve:
+            out["serve"] = serve
+
+    serves = [r for r in records if r.get("kind") == "serve"]
+    if serves and "serve" not in out:
+        out["serve"] = _serve_counters(serves[-1])
     return out
 
 

@@ -144,6 +144,30 @@ def main(argv=None) -> int:
     _add_common(p_warm)
     p_warm.add_argument("--no-eval", action="store_true",
                         help="skip the eval executable")
+    p_warm.add_argument("--serve", action="store_true",
+                        help="also AOT-compile the serve bucket ladder "
+                             "(serve.buckets x serve.max_batch inference "
+                             "executables) so a cold engine's first "
+                             "requests load instead of compiling")
+    p_warm.add_argument("--serve-only", action="store_true",
+                        help="compile only the serve ladder (skip "
+                             "train/eval)")
+
+    p_srv = sub.add_parser(
+        "serve", help="inference serving (DESIGN.md \"Serving\"): dynamic "
+                      "micro-batching engine over the latest verified "
+                      "checkpoint. Default: stdlib HTTP server (POST "
+                      "/v1/flow, GET /healthz) with a serve heartbeat in "
+                      "--log-dir; with --input: offline high-throughput "
+                      "directory/video inference to --out")
+    _add_common(p_srv)
+    p_srv.add_argument("--input", default=None,
+                       help="offline mode: a directory of frames "
+                            "(consecutive sorted pairs) or a video file")
+    p_srv.add_argument("--out", default=None,
+                       help="offline mode: output directory for "
+                            ".flo/.png results")
+    p_srv.add_argument("--no-png", action="store_true")
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     p_bench.add_argument("--model", default="inception_v3")
@@ -276,7 +300,7 @@ def main(argv=None) -> int:
         jax.distributed.initialize()  # coordinator/process env-configured
 
     if args.cmd == "warmup":
-        from .train.warmup import enable_for_config, warmup_compile
+        from .train.warmup import enable_for_config, warmup_compile, warmup_serve
 
         # the verb's sole purpose is populating the cache: refuse to
         # silently pay minutes of XLA and persist nothing. On cpu the
@@ -289,11 +313,31 @@ def main(argv=None) -> int:
                   "train.compile_cache=true to opt in) — nothing would "
                   "be persisted, refusing to compile", file=sys.stderr)
             return 2
-        res = warmup_compile(cfg, include_eval=not args.no_eval)
+        if args.serve_only:
+            res = warmup_serve(cfg)
+        else:
+            res = warmup_compile(cfg, include_eval=not args.no_eval)
+            if args.serve:
+                res["serve"] = warmup_serve(cfg)
         print(json.dumps(res))
         # nonzero when the cache was already warm is WRONG here — a warm
         # cache is the goal; rc reflects only "did warmup complete"
         return 0
+
+    if args.cmd == "serve":
+        if (args.input is None) != (args.out is None):
+            raise SystemExit("serve: offline mode needs BOTH --input and "
+                             "--out (neither = HTTP server mode)")
+        if args.input is not None:
+            from .serve.server import run_offline
+
+            res = run_offline(cfg, args.input, args.out,
+                              write_png=not args.no_png)
+            print(json.dumps(res))
+            return 0
+        from .serve.server import run_server
+
+        return run_server(cfg)
 
     if args.cmd == "predict":
         from .predict import predict_pairs

@@ -275,6 +275,43 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Inference serving subsystem (deepof_tpu/serve/, DESIGN.md
+    "Serving"): the dynamic micro-batching engine, the shape-bucket
+    ladder, and the zero-dependency HTTP/offline frontends."""
+
+    # Dynamic micro-batcher: pending requests coalesce into one batched
+    # forward of up to max_batch pairs; a partial batch flushes when the
+    # OLDEST pending request has waited batch_timeout_ms (latency bound).
+    # Every dispatch is padded to exactly max_batch rows, so each bucket
+    # owns ONE executable (no per-occupancy recompiles) and a response is
+    # bit-identical whatever batch it rode in.
+    max_batch: int = 8
+    batch_timeout_ms: float = 10.0
+    # Shape-bucket resolution ladder, (H, W) network-input sizes (model
+    # stride constraints apply — multiples of 64, like data.image_size).
+    # Arbitrary native inputs map to the smallest covering bucket (else
+    # the largest) and flow vectors rescale back to native pixel units,
+    # so the set of compiled executables is fixed and warmable
+    # (`warmup --serve`). () = one bucket at data.image_size.
+    buckets: tuple[tuple[int, int], ...] = ()
+    # Request-queue bound: submit() blocks when this many requests are
+    # pending (backpressure instead of unbounded host memory). 0 = unbounded.
+    queue_depth: int = 256
+    # HTTP frontend (`deepof_tpu serve`): stdlib http.server, JSON/PNG/.flo
+    # responses, /healthz for the serve counters.
+    host: str = "127.0.0.1"
+    port: int = 8191
+    # Per-request wall-clock bound the HTTP handler waits on a future
+    # before answering 504 (the engine keeps working; the slot is freed).
+    request_timeout_s: float = 30.0
+    # Offline mode (`deepof_tpu serve --input ... --out ...`): decode
+    # workers for the data/pipeline.py pool that feeds the engine.
+    # 0 = decode inline on the submit thread.
+    workers: int = 0
+
+
+@dataclass(frozen=True)
 class ResilienceConfig:
     """Fault-tolerance layer (deepof_tpu/resilience/, DESIGN.md
     "Resilience"): the self-healing data path, verified checkpoints, the
@@ -345,6 +382,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

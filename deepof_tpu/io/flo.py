@@ -39,13 +39,18 @@ def read_flo(path: str | os.PathLike) -> np.ndarray:
         return data.reshape(int(h), int(w), 2).copy()
 
 
-def write_flo(path: str | os.PathLike, flow: np.ndarray) -> None:
-    """Write (H, W, 2) float32 flow to Middlebury `.flo`."""
-    flow = np.asarray(flow, dtype=np.float32)
+def flo_bytes(flow: np.ndarray) -> bytes:
+    """(H, W, 2) float32 flow -> Middlebury `.flo` bytes (the single
+    owner of the serialization — `write_flo` and the serving HTTP
+    response body both use it)."""
+    flow = np.ascontiguousarray(flow, dtype=np.float32)
     if flow.ndim != 3 or flow.shape[-1] != 2:
         raise ValueError(f"flow must be (H, W, 2), got {flow.shape}")
     h, w = flow.shape[:2]
+    return _TAG_BYTES + np.array([w, h], np.int32).tobytes() + flow.tobytes()
+
+
+def write_flo(path: str | os.PathLike, flow: np.ndarray) -> None:
+    """Write (H, W, 2) float32 flow to Middlebury `.flo`."""
     with open(path, "wb") as f:
-        f.write(_TAG_BYTES)
-        np.array([w, h], np.int32).tofile(f)
-        flow.tofile(f)
+        f.write(flo_bytes(flow))

@@ -7,97 +7,138 @@ inside the training/eval session loops (`flyingChairsTrain.py:216-296`,
 the finest pyramid flow, run the eval amplifier/clip/resize protocol, and
 serialize with the (fixed) Middlebury writer — the reference's `writeFlow`
 was dead code (`utils.py:44`, undefined TAG_CHAR).
+
+Since the serving subsystem (DESIGN.md "Serving"), this module is a thin
+offline frontend over `serve.engine.InferenceEngine`: pairs are submitted
+to the dynamic micro-batcher and execute in device batches of up to
+`serve.max_batch` instead of one dispatch per pair, and params restore
+through the verified-checkpoint path (resilience layer) instead of a raw
+orbax read.
 """
 
 from __future__ import annotations
 
 import os
 
+import cv2
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from .core.config import ExperimentConfig
-from .data.datasets import _imread_bgr, _resize
 from .io.flo import write_flo
-from .losses.pyramid import preprocess
-from .models.registry import build_model
-from .train.evaluate import postprocess_flow
 from .utils.flowviz import flow_to_color
 
 
 def restore_params(cfg: ExperimentConfig):
-    """Latest-checkpoint params from cfg.train.log_dir (Trainer layout)."""
+    """Params from the newest VERIFIED checkpoint under
+    cfg.train.log_dir (Trainer layout).
+
+    Restore goes through the resilience layer's manifest verification
+    (`train/checkpoint.py` + `resilience/verify.py`): a candidate whose
+    manifest fails checksum/structure validation is skipped with a
+    warning and the next-newest valid step restores instead — serving
+    never loads a torn or bit-flipped checkpoint. Disable with
+    resilience.verify_checkpoints=false.
+    """
+    from .serve.engine import build_serve_model
     from .train.checkpoint import CheckpointManager
     from .train.schedule import step_decay_schedule
     from .train.state import create_train_state, make_optimizer
 
     t = cfg.data.time_step
-    model = build_model(cfg.model, flow_channels=2 * (t - 1),
-                        width_mult=cfg.width_mult,
-                        corr_max_disp=cfg.corr_max_disp,
-                        corr_stride=cfg.corr_stride)
+    model = build_serve_model(cfg)
     h, w = cfg.data.image_size  # eval-protocol resolution (val is uncropped)
     tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
     template = create_train_state(
         model, jnp.zeros((1, h, w, 3 * t)), tx, seed=0)
-    state = CheckpointManager(cfg.train.log_dir + "/ckpt",
-                          async_save=False).restore(template)
+    ckpt_dir = cfg.train.log_dir + "/ckpt"
+    mgr = CheckpointManager(ckpt_dir, async_save=False, create=False,
+                            verify=cfg.resilience.verify_checkpoints)
+    state = mgr.restore(template)
     if state is None:
+        candidates = mgr.all_steps()
+        if candidates:
+            raise RuntimeError(
+                f"checkpoints exist under {ckpt_dir} (steps {candidates}) "
+                "but none restored — all candidates failed verification or "
+                f"the read itself; run `python -m deepof_tpu verify-ckpt "
+                f"{cfg.train.log_dir}` to see per-step corruption detail")
         raise FileNotFoundError(
-            f"no checkpoint under {cfg.train.log_dir}/ckpt")
+            f"no checkpoint under {ckpt_dir} (run `python -m deepof_tpu "
+            f"verify-ckpt {cfg.train.log_dir}` to inspect the directory)")
     return model, state.params
 
 
-def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
-                  out_dir: str, mean=None,
+def write_outputs(out_dir: str, stem: str, flow: np.ndarray,
                   write_png: bool = True) -> list[str]:
+    """Serialize one native-resolution flow: `.flo` (+ flow-color png).
+    Shared by predict_pairs and the offline serve mode."""
+    written = []
+    flo_path = os.path.join(out_dir, f"{stem}_flow.flo")
+    write_flo(flo_path, flow)
+    written.append(flo_path)
+    if write_png:
+        png_path = os.path.join(out_dir, f"{stem}_flow.png")
+        cv2.imwrite(png_path, flow_to_color(flow))
+        written.append(png_path)
+    return written
+
+
+def output_stem(src_path: str, idx: int, many: bool) -> str:
+    stem = os.path.splitext(os.path.basename(src_path))[0]
+    # basenames may collide across dirs once there is more than one pair
+    return f"{idx:04d}_{stem}" if many else stem
+
+
+def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
+                  out_dir: str, mean=None, write_png: bool = True,
+                  model_params=None) -> list[str]:
     """Predict flow for (prev, next) image-path pairs; returns written paths.
 
-    The net runs at cfg.data.image_size (the eval resolution — val samples
-    are never cropped); the output is amplified/clipped per the eval
-    protocol (`flyingChairsTrain.py:264-296`), resized to the source image
-    resolution, and — unlike the reference's AEE protocol, which resizes
-    the flow *map* only — the u/v vectors are rescaled by (W_native/W_net,
-    H_native/H_net) so the standalone `.flo` is in native pixel units.
+    The net runs at the request's shape bucket (default ladder: one
+    bucket at cfg.data.image_size — the eval resolution); the output is
+    amplified/clipped per the eval protocol (`flyingChairsTrain.py:
+    264-296`), resized to the source image resolution, and — unlike the
+    reference's AEE protocol, which resizes the flow *map* only — the
+    u/v vectors are rescaled by (W_native/W_net, H_native/H_net) so the
+    standalone `.flo` is in native pixel units.
+
+    Execution goes through the serving engine: all pairs are enqueued up
+    front and the micro-batcher coalesces them into device batches of up
+    to `serve.max_batch` (one dispatch per flush instead of one per
+    pair). Responses are bit-identical to the serial per-pair path at
+    the same bucket (padded fixed-occupancy dispatch; pinned in tests).
+
+    model_params: optional (model, params) overriding the checkpoint
+    restore (tests; callers that already restored).
     """
-    from .data.datasets import DATASET_MEANS
+    from collections import deque
 
-    model, params = restore_params(cfg)
-    mean = mean if mean is not None else DATASET_MEANS.get(
-        cfg.data.dataset, DATASET_MEANS["flyingchairs"])
-    h, w = cfg.data.image_size
-
-    @jax.jit
-    def fwd(params, pair):
-        flows = model.apply({"params": params}, pair)
-        return flows[0] * model.flow_scales[0]
+    from .serve.engine import InferenceEngine
 
     os.makedirs(out_dir, exist_ok=True)
-    written = []
-    for idx, (src_path, tgt_path) in enumerate(pairs):
-        src_raw = _imread_bgr(src_path)
-        native_hw = src_raw.shape[:2]
-        src = _resize(src_raw, (h, w)).astype(np.float32)
-        tgt = _resize(_imread_bgr(tgt_path), (h, w)).astype(np.float32)
-        pair = jnp.concatenate(
-            [preprocess(jnp.asarray(src[None]), mean),
-             preprocess(jnp.asarray(tgt[None]), mean)], axis=-1)
-        flow = np.asarray(fwd(params, pair))
-        flow = postprocess_flow(flow, cfg, native_hw)[0, :, :, :2]
-        flow[..., 0] *= native_hw[1] / w  # u: native horizontal px
-        flow[..., 1] *= native_hw[0] / h  # v: native vertical px
+    written: list[str] = []
+    many = len(pairs) > 1
+    with InferenceEngine(cfg, model_params=model_params, mean=mean) as eng:
+        # bounded outstanding-futures window: a resolved future holds a
+        # full native-resolution flow, so consuming-as-we-submit (not
+        # after submitting everything) keeps host memory O(window) on
+        # arbitrarily long pair lists — and overlaps writes with
+        # in-flight inference
+        window = max(4 * eng.max_batch, 16)
+        buf: deque = deque()
 
-        stem = os.path.splitext(os.path.basename(src_path))[0]
-        if len(pairs) > 1:
-            stem = f"{idx:04d}_{stem}"  # basenames may collide across dirs
-        flo_path = os.path.join(out_dir, f"{stem}_flow.flo")
-        write_flo(flo_path, flow)
-        written.append(flo_path)
-        if write_png:
-            import cv2
+        def drain_one() -> None:
+            idx, src_path, fut = buf.popleft()
+            flow = fut.result()["flow"]
+            written.extend(write_outputs(
+                out_dir, output_stem(src_path, idx, many), flow,
+                write_png=write_png))
 
-            png_path = os.path.join(out_dir, f"{stem}_flow.png")
-            cv2.imwrite(png_path, flow_to_color(flow))
-            written.append(png_path)
+        for idx, (src, tgt) in enumerate(pairs):
+            buf.append((idx, src, eng.submit(src, tgt)))
+            if len(buf) >= window:
+                drain_one()
+        while buf:
+            drain_one()
     return written

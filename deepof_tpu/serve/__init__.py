@@ -1,0 +1,17 @@
+"""Inference serving subsystem (DESIGN.md "Serving").
+
+`engine.InferenceEngine` — restored+verified params behind a dynamic
+micro-batcher (request queue -> coalesced padded batches -> one AOT
+executable per shape bucket -> per-request futures).
+`buckets` — the shape-bucket ladder mapping arbitrary native inputs to
+a fixed, warmable executable set.
+`server` — the stdlib-only HTTP frontend and the offline
+directory/video high-throughput mode (`deepof_tpu serve`).
+
+Importing this package pulls in numpy only — jax and cv2 load lazily
+inside the engine paths that need them (the CLI imports this package
+before deciding whether it needs a backend at all).
+"""
+
+from .engine import InferenceEngine, ServeError  # noqa: F401
+from .buckets import pick_bucket, resolve_buckets  # noqa: F401

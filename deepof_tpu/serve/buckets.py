@@ -1,0 +1,89 @@
+"""Shape bucketing: arbitrary native inputs -> a fixed executable set.
+
+A jit/AOT executable is specialized to its input avals, so serving
+arbitrary image sizes naively means one XLA compile per distinct
+resolution — unbounded compile debt on a live endpoint. The ladder in
+`ServeConfig.buckets` fixes the set: every request maps to one of a few
+(H, W) network-input buckets, and only those executables exist (warmed
+ahead of time by `warmup --serve` through the PR 1 persistent cache, so
+the first request of each bucket loads instead of compiling).
+
+Mapping protocol — deliberately the SAME resize-based protocol the
+serial predict path and the eval sweep use (`train/evaluate.py
+postprocess_flow`), not letterbox padding: the native image is resized
+to the bucket resolution, the net runs at the bucket shape, the finest
+flow is amplified/clipped/resized back to native resolution, and the
+u/v vectors are rescaled by (W_native/W_bucket, H_native/H_bucket) into
+native pixel units. Sharing the protocol is what makes an engine
+response bit-identical to the serial path's output at the same bucket
+(pinned in tests/test_serve.py) — a padding scheme would change the
+numerics at every border.
+
+Bucket choice: the smallest-area bucket that covers the native
+resolution in both dimensions (no downscale in either axis), else the
+largest bucket (capped upscale cost). Deterministic in (native_hw,
+ladder) so identical requests always share an executable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.config import ExperimentConfig
+
+
+def resolve_buckets(cfg: ExperimentConfig) -> tuple[tuple[int, int], ...]:
+    """The config's ladder, normalized: explicit `serve.buckets` (sorted
+    by area then H for a stable warmup/selection order), or the single
+    `data.image_size` bucket — which makes the default engine behave
+    exactly like the pre-serve predict path."""
+    raw = cfg.serve.buckets or (tuple(cfg.data.image_size),)
+    buckets = []
+    for b in raw:
+        h, w = int(b[0]), int(b[1])
+        if h <= 0 or w <= 0:
+            raise ValueError(f"serve.buckets entry {b!r} must be positive (H, W)")
+        buckets.append((h, w))
+    return tuple(sorted(set(buckets), key=lambda b: (b[0] * b[1], b[0])))
+
+
+def pick_bucket(native_hw: tuple[int, int],
+                buckets: tuple[tuple[int, int], ...]) -> tuple[int, int]:
+    """Smallest-area bucket covering `native_hw` in both axes, else the
+    largest bucket in the ladder."""
+    h, w = native_hw
+    for bh, bw in buckets:  # sorted by area: first cover is the smallest
+        if bh >= h and bw >= w:
+            return (bh, bw)
+    return buckets[-1]
+
+
+def prepare_pair(src_raw: np.ndarray, tgt_raw: np.ndarray,
+                 bucket: tuple[int, int], mean) -> np.ndarray:
+    """Decoded BGR pair -> one network-input row (H, W, 6) float32 at the
+    bucket resolution: resize + the training preprocess (subtract BGR
+    mean, /255 — `losses/pyramid.py preprocess`, done here in numpy so a
+    corrupt input fails on the submitting thread, before batching)."""
+    from ..data.datasets import _resize
+
+    m = np.asarray(mean, np.float32)
+    rows = [((_resize(img, bucket).astype(np.float32) - m) / np.float32(255.0))
+            for img in (src_raw, tgt_raw)]
+    return np.concatenate(rows, axis=-1)
+
+
+def flow_to_native(flow: np.ndarray, cfg: ExperimentConfig,
+                   bucket: tuple[int, int],
+                   native_hw: tuple[int, int]) -> np.ndarray:
+    """Finest scaled flow (H_b, W_b, 2) at bucket resolution -> native-
+    resolution flow in native pixel units: the eval amplify/clip/resize
+    protocol, then the u/v vector rescale (identical math to the serial
+    predict path — bit-for-bit parity is pinned in tests)."""
+    from ..train.evaluate import postprocess_flow
+
+    bh, bw = bucket
+    out = postprocess_flow(flow[None].astype(np.float32, copy=False),
+                           cfg, native_hw)[0, :, :, :2]
+    out[..., 0] *= native_hw[1] / bw  # u: native horizontal px
+    out[..., 1] *= native_hw[0] / bh  # v: native vertical px
+    return out

@@ -1,0 +1,544 @@
+"""InferenceEngine: a dynamic micro-batching flow-inference engine.
+
+The serial predict path pays one device round-trip per image pair and
+jits ad hoc; under concurrent load that is the whole throughput story.
+This engine owns the restored (verified) params and amortizes dispatch:
+
+  submit() threads enqueue preprocessed requests -> a single batcher
+  thread coalesces the queue into one batched forward per flush (up to
+  `serve.max_batch` pairs, or whatever arrived within
+  `serve.batch_timeout_ms` of the oldest pending request) -> per-request
+  futures resolve with postprocessed native-resolution flow.
+
+Design decisions that matter:
+
+  - Every dispatch is padded to EXACTLY max_batch rows (zeros beyond the
+    live occupancy, outputs sliced). One bucket therefore owns one
+    executable — occupancy 1..max_batch never triggers a recompile — and
+    a response is bitwise independent of which batch it rode in, so the
+    batched path is bit-identical to the serial path at the same bucket
+    (pinned in tests/test_serve.py).
+  - Executables are AOT-compiled (`jit(...).lower(avals).compile()`)
+    through the PR 1 persistent compile cache; `warmup --serve` runs the
+    identical lowering per bucket ahead of time, so a cold engine's
+    first requests LOAD executables instead of compiling (compile-cache
+    counters pinned in tests).
+  - Decode/preprocess runs on the SUBMITTING thread (cv2 releases the
+    GIL): a corrupt or undecodable input fails that one future with a
+    structured ServeError before it ever reaches the batcher — a
+    poisoned request cannot wedge the engine or fail its batchmates.
+  - A failure inside the batched forward fails that flush's requests
+    (structured `dispatch_failed`) and the batcher keeps serving; a
+    per-request postprocess failure fails only that request.
+
+Observability: trace spans (serve_enqueue / serve_batch /
+serve_dispatch / serve_postprocess) on the shared obs tracer, and a
+`serve_*` counter block (queue depth, batch occupancy, p50/p99 latency,
+requests/s) exposed via stats()/heartbeat_sample() for the serve
+heartbeat and `deepof_tpu tail`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable
+
+import numpy as np
+
+from ..core.config import ExperimentConfig
+from ..obs import trace as obs_trace
+from .buckets import flow_to_native, pick_bucket, prepare_pair, resolve_buckets
+
+_STOP = object()
+
+#: Latency samples retained for the p50/p99 estimate (newest window).
+_LATENCY_WINDOW = 2048
+#: Seconds of completion history behind the requests/s figure.
+_RATE_WINDOW_S = 10.0
+
+
+class ServeError(RuntimeError):
+    """Structured per-request failure: machine-readable `code` +
+    human-readable message, JSON-ready via payload(). Codes:
+    bad_input (decode/preprocess), dispatch_failed (the batched forward
+    raised — the whole flush fails), postprocess_failed (one request's
+    resize/rescale raised), engine_closed, bad_request (server-side)."""
+
+    def __init__(self, code: str, message: str,
+                 request_id: int | str | None = None):
+        super().__init__(message)
+        self.code = code
+        self.request_id = request_id
+
+    def payload(self) -> dict:
+        out = {"error": self.code, "message": str(self)}
+        if self.request_id is not None:
+            out["request_id"] = self.request_id
+        return out
+
+
+class _Request:
+    __slots__ = ("x", "bucket", "native_hw", "future", "t_enq", "rid")
+
+    def __init__(self, x, bucket, native_hw, future, t_enq, rid):
+        self.x = x
+        self.bucket = bucket
+        self.native_hw = native_hw
+        self.future = future
+        self.t_enq = t_enq
+        self.rid = rid
+
+
+def build_serve_model(cfg: ExperimentConfig):
+    """The inference model for a config — the same build the serial
+    predict path and `warmup --serve` use, so executables compiled by
+    either are interchangeable cache entries."""
+    from ..models.registry import build_model
+
+    t = cfg.data.time_step
+    return build_model(cfg.model, flow_channels=2 * (t - 1),
+                      width_mult=cfg.width_mult,
+                      corr_max_disp=cfg.corr_max_disp,
+                      corr_stride=cfg.corr_stride)
+
+
+def make_raw_forward(model) -> Callable:
+    """(params, pairs[B,H,W,6]) -> finest scaled flow [B,h,w,2]. Defined
+    once so the engine's runtime lowering and warmup's AOT lowering
+    produce the same HLO (same persistent-cache key)."""
+
+    def fwd(params, x):
+        flows = model.apply({"params": params}, x)
+        return flows[0] * model.flow_scales[0]
+
+    return fwd
+
+
+#: Serving is pair-based: prepare_pair always concatenates exactly two
+#: preprocessed BGR frames, so every executable takes 6 input channels
+#: (multi-frame T-volume configs are a training shape, not a serving one).
+PAIR_CHANNELS = 6
+
+
+def serve_avals(params, bucket: tuple[int, int], max_batch: int):
+    """(params_sds, x_sds) for one bucket executable — shared by
+    engine._executable and warmup_serve so their cache keys match.
+    `params` may be real arrays or ShapeDtypeStructs."""
+    import jax
+
+    params_sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(getattr(a, "shape", ()), a.dtype),
+        params)
+    x_sds = jax.ShapeDtypeStruct(
+        (max_batch, bucket[0], bucket[1], PAIR_CHANNELS), np.float32)
+    return params_sds, x_sds
+
+
+class InferenceEngine:
+    """See module docstring.
+
+    cfg: full experiment config (serve.* drives the batcher; data/eval
+        fields drive the preprocess/postprocess protocol).
+    model_params: optional (model, params) — skips checkpoint restore
+        (tests, and predict_pairs after it already restored).
+    mean: optional BGR dataset mean override (DATASET_MEANS default).
+    forward_fn: optional (bucket, x[max_batch,H,W,6]) -> [max_batch,h,w,2]
+        executor replacing the jitted model entirely — the deterministic
+        fake timed executor the batcher tests and serve_bench use.
+    """
+
+    def __init__(self, cfg: ExperimentConfig, model_params=None, mean=None,
+                 forward_fn: Callable | None = None):
+        self.cfg = cfg
+        self.max_batch = max(int(cfg.serve.max_batch), 1)
+        self.timeout_s = max(float(cfg.serve.batch_timeout_ms), 0.0) / 1e3
+        self.buckets = resolve_buckets(cfg)
+        if mean is None:
+            from ..data.datasets import DATASET_MEANS
+
+            mean = DATASET_MEANS.get(cfg.data.dataset,
+                                     DATASET_MEANS["flyingchairs"])
+        self.mean = mean
+
+        self._forward_custom = forward_fn is not None
+        if self._forward_custom:
+            self._forward = forward_fn
+            self._model = self._params = None
+        else:
+            if model_params is not None:
+                self._model, self._params = model_params
+            else:
+                from ..predict import restore_params
+
+                self._model, self._params = restore_params(cfg)
+            import jax
+
+            from ..train.warmup import enable_for_config
+
+            # persistent compile cache per config policy (auto: on for
+            # accelerator backends): a cold serving process after
+            # `warmup --serve` loads its bucket executables instead of
+            # compiling them
+            enable_for_config(cfg)
+            # AOT executables are lowered from bare avals — the same
+            # single-device lowering `warmup --serve` persists (cache-key
+            # parity). Params restored onto a replicated mesh sharding
+            # would mismatch that compiled input spec, so serving
+            # canonicalizes them onto one device; scale-out is N engine
+            # processes, not in-engine batch sharding.
+            self._params = jax.device_put(self._params, jax.devices()[0])
+            self._jit = jax.jit(make_raw_forward(self._model))
+            self._forward = self._model_forward
+        self._compiled: dict[tuple[int, int], object] = {}
+        self._compile_lock = threading.Lock()
+
+        depth = max(int(cfg.serve.queue_depth), 0)
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._closed = False
+        self._rid = itertools.count(1)
+        # after each flush: (total_responses) -> None — the serve
+        # heartbeat's beat() hook (server.py wires it)
+        self.flush_hook: Callable[[int], None] | None = None
+
+        # --- counters (guarded by _stats_lock; GIL-atomic reads are not
+        # enough for the multi-field snapshots stats() returns) ---
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._responses = 0
+        self._errors = 0
+        self._batches = 0
+        self._dispatch_failures = 0
+        self._bucket_splits = 0
+        self._timeout_flushes = 0
+        self._occupancy_sum = 0
+        self._last_occupancy = 0
+        self._max_queue_depth = 0
+        self._submitting = 0  # submit() threads currently inside put()
+        self._latency_s: deque = deque(maxlen=_LATENCY_WINDOW)
+        # per-second completion buckets for requests/s — unlike reusing
+        # the latency deque, this can't clamp the rate at high load
+        self._done_per_s: dict[int, int] = {}
+
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+    def _decode(self, img) -> np.ndarray:
+        """Path -> decoded BGR array (arrays pass through validated)."""
+        if isinstance(img, np.ndarray):
+            if img.ndim != 3 or img.shape[-1] != 3:
+                raise ServeError("bad_input",
+                                 f"image array must be (H, W, 3) BGR, "
+                                 f"got {img.shape}")
+            return img
+        from ..data.datasets import _imread_bgr
+
+        return _imread_bgr(str(img))
+
+    def submit(self, prev, nxt) -> Future:
+        """Enqueue one (prev, next) pair — paths or decoded BGR arrays.
+
+        Returns a Future resolving to {"flow": (H_native, W_native, 2)
+        float32 in native pixel units, "bucket", "native_hw",
+        "latency_s", "request_id"}; failures raise ServeError from
+        .result(). Decode/preprocess errors fail HERE (this request
+        only) — they never enter the batcher.
+        """
+        rid = next(self._rid)
+        fut: Future = Future()
+        with self._stats_lock:
+            self._requests += 1
+        try:
+            with obs_trace.span("serve_enqueue", request_id=rid):
+                src = self._decode(prev)
+                tgt = self._decode(nxt)
+                native_hw = (int(src.shape[0]), int(src.shape[1]))
+                bucket = pick_bucket(native_hw, self.buckets)
+                x = prepare_pair(src, tgt, bucket, self.mean)
+            self._enqueue(_Request(x, bucket, native_hw, fut,
+                                   time.monotonic(), rid))
+        except ServeError as e:
+            e.request_id = e.request_id or rid
+            self._fail(fut, e)
+        except Exception as e:  # noqa: BLE001 - decode errors are per-request
+            self._fail(fut, ServeError(
+                "bad_input", f"{type(e).__name__}: {e}", rid))
+        return fut
+
+    def submit_prepared(self, x: np.ndarray, bucket: tuple[int, int],
+                        native_hw: tuple[int, int]) -> Future:
+        """Enqueue an already-preprocessed row (offline mode: the
+        data/pipeline.py worker pool runs prepare_pair concurrently and
+        feeds rows here in order)."""
+        rid = next(self._rid)
+        fut: Future = Future()
+        with self._stats_lock:
+            self._requests += 1
+        try:
+            self._enqueue(_Request(np.asarray(x, np.float32), tuple(bucket),
+                                   tuple(native_hw), fut,
+                                   time.monotonic(), rid))
+        except ServeError as e:
+            e.request_id = e.request_id or rid
+            self._fail(fut, e)
+        return fut
+
+    def _enqueue(self, req: _Request) -> None:
+        with self._stats_lock:
+            if self._closed:
+                raise ServeError("engine_closed", "engine is shut down",
+                                 req.rid)
+            self._submitting += 1
+        try:
+            # bounded put = backpressure, but polled: a submitter blocked
+            # on a full queue must observe close() instead of completing
+            # its put into a dead queue (its future would never resolve —
+            # close() drains only after _submitting hits 0)
+            while True:
+                if self._closed:
+                    raise ServeError("engine_closed", "engine is shut down",
+                                     req.rid)
+                try:
+                    self._q.put(req, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        finally:
+            with self._stats_lock:
+                self._submitting -= 1
+        with self._stats_lock:
+            self._max_queue_depth = max(self._max_queue_depth,
+                                        self._q.qsize())
+
+    def _fail(self, fut: Future, err: ServeError) -> None:
+        with self._stats_lock:
+            self._errors += 1
+        fut.set_exception(err)
+
+    # ----------------------------------------------------------- batcher
+    def _run(self) -> None:
+        pending: _Request | None = None  # carried over a bucket split
+        stop = False
+        while not stop:
+            if pending is not None:
+                req, pending = pending, None
+            else:
+                req = self._q.get()
+            if req is _STOP:
+                break
+            batch = [req]
+            timed_out = False
+            with obs_trace.span("serve_batch"):
+                while len(batch) < self.max_batch:
+                    rem = (batch[0].t_enq + self.timeout_s) - time.monotonic()
+                    try:
+                        nxt = (self._q.get(timeout=rem) if rem > 0
+                               else self._q.get_nowait())
+                    except queue.Empty:
+                        timed_out = True  # the oldest waited out the deadline
+                        break
+                    if nxt is _STOP:
+                        stop = True
+                        break
+                    if nxt.bucket != batch[0].bucket:
+                        pending = nxt  # flush now; it opens the next batch
+                        with self._stats_lock:
+                            self._bucket_splits += 1
+                        break
+                    batch.append(nxt)
+            if timed_out and len(batch) < self.max_batch:
+                with self._stats_lock:
+                    self._timeout_flushes += 1
+            self._flush(batch)
+        # anything still queued after _STOP was submitted post-close
+        # bookkeeping started — fail it loudly rather than hang a caller
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _STOP:
+                self._fail(req.future, ServeError(
+                    "engine_closed", "engine shut down before dispatch",
+                    req.rid))
+
+    def _flush(self, batch: list[_Request]) -> None:
+        bucket = batch[0].bucket
+        n = len(batch)
+        tag = f"{bucket[0]}x{bucket[1]}"
+        with obs_trace.span("serve_dispatch", occupancy=n, bucket=tag):
+            x = np.zeros((self.max_batch, bucket[0], bucket[1],
+                          batch[0].x.shape[-1]), np.float32)
+            for i, r in enumerate(batch):
+                x[i] = r.x
+            try:
+                out = np.asarray(self._forward(bucket, x))
+            except Exception as e:  # noqa: BLE001 - the flush fails, not the engine
+                with self._stats_lock:
+                    self._dispatch_failures += 1
+                for r in batch:
+                    self._fail(r.future, ServeError(
+                        "dispatch_failed", f"{type(e).__name__}: {e}", r.rid))
+                return
+        with obs_trace.span("serve_postprocess", occupancy=n, bucket=tag):
+            for i, r in enumerate(batch):
+                try:
+                    flow = flow_to_native(out[i], self.cfg, bucket,
+                                          r.native_hw)
+                except Exception as e:  # noqa: BLE001 - one request's failure
+                    self._fail(r.future, ServeError(
+                        "postprocess_failed",
+                        f"{type(e).__name__}: {e}", r.rid))
+                    continue
+                done = time.monotonic()
+                with self._stats_lock:
+                    self._responses += 1
+                    self._latency_s.append(done - r.t_enq)
+                    sec = int(done)
+                    self._done_per_s[sec] = self._done_per_s.get(sec, 0) + 1
+                    if len(self._done_per_s) > _RATE_WINDOW_S + 5:
+                        for old in [s for s in self._done_per_s
+                                    if s < sec - _RATE_WINDOW_S - 1]:
+                            del self._done_per_s[old]
+                r.future.set_result({"flow": flow, "bucket": bucket,
+                                     "native_hw": r.native_hw,
+                                     "latency_s": done - r.t_enq,
+                                     "request_id": r.rid})
+        with self._stats_lock:
+            self._batches += 1
+            self._occupancy_sum += n
+            self._last_occupancy = n
+            total = self._responses
+        hook = self.flush_hook
+        if hook is not None:
+            try:
+                hook(total)
+            except Exception:  # noqa: BLE001 - observability must not kill serving
+                pass
+
+    # ---------------------------------------------------------- forward
+    def _model_forward(self, bucket: tuple[int, int], x: np.ndarray):
+        return self._executable(bucket)(self._params, x)
+
+    def _executable(self, bucket: tuple[int, int]):
+        """The bucket's AOT-compiled forward, compiled (or loaded from
+        the persistent cache — the `warmup --serve` contract) on first
+        use."""
+        with self._compile_lock:
+            c = self._compiled.get(bucket)
+            if c is None:
+                params_sds, x_sds = serve_avals(self._params, bucket,
+                                                self.max_batch)
+                c = self._jit.lower(params_sds, x_sds).compile()
+                self._compiled[bucket] = c
+        return c
+
+    def warm(self) -> dict:
+        """AOT-compile every configured bucket now (server startup /
+        offline-mode entry), through the persistent compile cache when
+        active — after `warmup --serve` these are loads, not compiles.
+        Returns per-bucket timings + the cache hit/miss delta."""
+        # the postprocess import chain (train/evaluate and friends) is
+        # first-request latency too — ~seconds in a fresh process, paid
+        # inside the batcher thread if not paid here (measured via
+        # tools/serve_bench.py)
+        flow_to_native(np.zeros((2, 2, 2), np.float32), self.cfg,
+                       (2, 2), (2, 2))
+        if self._forward_custom:
+            return {"buckets": [], "cache": None}  # nothing to compile
+        from ..train.warmup import cache_delta
+
+        out: dict = {"buckets": []}
+        with cache_delta() as d:
+            for b in self.buckets:
+                t0 = time.perf_counter()
+                self._executable(b)
+                out["buckets"].append(
+                    {"bucket": list(b),
+                     "compile_s": round(time.perf_counter() - t0, 3)})
+        out["cache"] = d.stats()
+        return out
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """The serve_* counter block (heartbeat / tail / serve_bench)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            lat = sorted(self._latency_s)
+            recent = sum(c for s, c in self._done_per_s.items()
+                         if now - s <= _RATE_WINDOW_S)
+            out = {
+                "serve_requests": self._requests,
+                "serve_responses": self._responses,
+                "serve_errors": self._errors,
+                "serve_batches": self._batches,
+                "serve_dispatch_failures": self._dispatch_failures,
+                "serve_bucket_splits": self._bucket_splits,
+                "serve_timeout_flushes": self._timeout_flushes,
+                "serve_queue_depth": self._q.qsize(),
+                "serve_max_queue_depth": self._max_queue_depth,
+                "serve_last_occupancy": self._last_occupancy,
+                "serve_occupancy_mean": (
+                    round(self._occupancy_sum / self._batches, 3)
+                    if self._batches else None),
+                "serve_max_batch": self.max_batch,
+                "serve_buckets": len(self.buckets),
+            }
+        if lat:
+            out["serve_latency_p50_ms"] = round(
+                1e3 * lat[int(0.50 * (len(lat) - 1))], 3)
+            out["serve_latency_p99_ms"] = round(
+                1e3 * lat[int(0.99 * (len(lat) - 1))], 3)
+        else:
+            out["serve_latency_p50_ms"] = None
+            out["serve_latency_p99_ms"] = None
+        out["serve_requests_per_s"] = round(recent / _RATE_WINDOW_S, 3)
+        return out
+
+    def heartbeat_sample(self) -> dict:
+        """Heartbeat `sample` callback — same keys as stats()."""
+        return self.stats()
+
+    # ------------------------------------------------------------- close
+    def close(self) -> None:
+        """Flush everything already queued, then stop the batcher.
+        Idempotent; submissions after close fail with engine_closed."""
+        with self._stats_lock:
+            if self._closed:
+                return
+            self._closed = True
+        # drains in order: queued work still serves. The put can block on
+        # a full queue only until the batcher frees a slot (it is still
+        # consuming at this point).
+        self._q.put(_STOP)
+        self._thread.join(timeout=60.0)
+        # submitters that passed the closed check before we flipped it
+        # may still complete a put; wait them out, then fail any request
+        # the (now dead) batcher will never see
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                if self._submitting == 0:
+                    break
+            time.sleep(0.01)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _STOP:
+                self._fail(req.future, ServeError(
+                    "engine_closed", "engine shut down before dispatch",
+                    req.rid))
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,422 @@
+"""Zero-dependency flow-serving frontends over the InferenceEngine.
+
+Two modes behind the `deepof_tpu serve` CLI verb:
+
+  HTTP server — stdlib `http.server.ThreadingHTTPServer` (each request
+  handled on its own thread, so concurrent clients genuinely coalesce
+  in the engine's micro-batcher). JSON in, JSON/.flo/PNG out; no web
+  framework, no new dependency. A serve heartbeat (obs/heartbeat.py)
+  rewrites `<log_dir>/heartbeat.json` with the engine's serve_* block —
+  queue depth, batch occupancy, p50/p99 latency, requests/s — and its
+  watchdog dumps thread stacks if the batcher wedges; `deepof_tpu tail
+  --log-dir` reads both.
+
+  Offline mode — high-throughput directory/video inference: frame
+  pairs are decoded+preprocessed concurrently by the existing
+  `data/pipeline.py` worker pool (in-order delivery, serve.workers
+  threads), staged through a `data/prefetch.py` Prefetcher so the
+  submit loop never waits on decode, and streamed through the engine
+  while `.flo`/png writes overlap the next batch's inference.
+
+API:
+  GET  /healthz           -> 200, the serve_* counter JSON
+  POST /v1/flow           -> body {"prev": <b64 image>, "next": <b64>,
+                             "format": "json"|"flo"|"png"}
+    json: {"flow_b64": <b64 raw float32 (H,W,2) little-endian>,
+           "shape": [H, W, 2], "bucket": [h, w], "latency_ms": ...}
+    flo:  application/octet-stream Middlebury .flo bytes
+    png:  image/png flow-color rendering
+  Errors are structured: 4xx/5xx with a ServeError payload
+  ({"error": code, "message": ...}); one bad request never affects its
+  batchmates or the engine.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+
+import numpy as np
+
+# pre-3.11 concurrent.futures.TimeoutError is not the builtin
+from concurrent.futures import TimeoutError as _FuturesTimeout
+
+from ..core.config import ExperimentConfig
+from ..io.flo import flo_bytes
+from .engine import InferenceEngine, ServeError
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".ppm", ".bmp")
+_VIDEO_EXTS = (".mp4", ".avi", ".mov", ".mkv", ".webm")
+
+
+# --------------------------------------------------------------- HTTP
+
+
+def _decode_b64_image(b64: str, field: str) -> np.ndarray:
+    import cv2
+
+    try:
+        raw = base64.b64decode(b64, validate=True)
+    except Exception as e:  # noqa: BLE001 - client error, structured reply
+        raise ServeError("bad_request", f"{field}: invalid base64: {e}")
+    img = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_COLOR)
+    if img is None:
+        raise ServeError("bad_input", f"{field}: undecodable image bytes")
+    return img
+
+
+def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
+    """A ThreadingHTTPServer bound to cfg.serve.host:port serving the
+    engine. Returned unstarted (call serve_forever / run in a thread) so
+    tests drive it on an ephemeral port."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    timeout_s = max(float(cfg.serve.request_timeout_s), 0.1)
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True  # a stuck client never blocks shutdown
+
+        def handle_error(self, request, client_address):
+            # client disconnects (reset/broken pipe mid-response) are
+            # routine on a public endpoint, not stack-trace material;
+            # everything else keeps the default diagnostic dump
+            import sys
+
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (ConnectionError, TimeoutError)):
+                return
+            super().handle_error(request, client_address)
+
+    class Handler(BaseHTTPRequestHandler):
+        # the engine is shared; per-request state stays on the stack
+        protocol_version = "HTTP/1.1"  # keep-alive (Content-Length always set)
+
+        def log_message(self, fmt, *args):  # quiet: obs owns visibility
+            pass
+
+        def _reply(self, status: int, body: bytes,
+                   ctype: str = "application/json") -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, status: int, payload: dict) -> None:
+            self._reply(status, json.dumps(payload).encode())
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+            if self.path in ("/healthz", "/stats"):
+                self._reply_json(200, engine.stats())
+            else:
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+
+        def do_POST(self):  # noqa: N802
+            if self.path not in ("/v1/flow", "/flow"):
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                fmt = req.get("format", "json")
+                if fmt not in ("json", "flo", "png"):
+                    raise ServeError("bad_request",
+                                     f"format must be json|flo|png, "
+                                     f"got {fmt!r}")
+                prev = _decode_b64_image(req.get("prev", ""), "prev")
+                nxt = _decode_b64_image(req.get("next", ""), "next")
+            except ServeError as e:
+                self._reply_json(400, e.payload())
+                return
+            except Exception as e:  # noqa: BLE001 - malformed body
+                self._reply_json(400, {"error": "bad_request",
+                                       "message": f"{type(e).__name__}: {e}"})
+                return
+            fut = engine.submit(prev, nxt)
+            try:
+                res = fut.result(timeout=timeout_s)
+            except ServeError as e:
+                status = 400 if e.code in ("bad_input", "bad_request") else 500
+                self._reply_json(status, e.payload())
+                return
+            except _FuturesTimeout:
+                self._reply_json(504, {"error": "timeout",
+                                       "message": f"no response within "
+                                                  f"{timeout_s}s"})
+                return
+            flow = res["flow"]
+            if fmt == "flo":
+                self._reply(200, flo_bytes(flow), "application/octet-stream")
+            elif fmt == "png":
+                import cv2
+
+                from ..utils.flowviz import flow_to_color
+
+                ok, png = cv2.imencode(".png", flow_to_color(flow))
+                if not ok:
+                    self._reply_json(500, {"error": "encode_failed",
+                                           "message": "png encode failed"})
+                    return
+                self._reply(200, png.tobytes(), "image/png")
+            else:
+                self._reply_json(200, {
+                    "shape": list(flow.shape),
+                    "bucket": list(res["bucket"]),
+                    "native_hw": list(res["native_hw"]),
+                    "latency_ms": round(res["latency_s"] * 1e3, 3),
+                    "request_id": res["request_id"],
+                    "flow_b64": base64.b64encode(
+                        np.ascontiguousarray(flow, "<f4").tobytes()).decode(),
+                })
+
+    return Server((cfg.serve.host, cfg.serve.port), Handler)
+
+
+def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
+               model_params=None) -> int:
+    """`deepof_tpu serve` (HTTP mode): engine + heartbeat + serve_forever
+    until SIGINT. Blocks; returns the exit code."""
+    from ..obs.heartbeat import Heartbeat
+
+    own_engine = engine is None
+    if own_engine:
+        engine = InferenceEngine(cfg, model_params=model_params)
+    warm = engine.warm()
+
+    # serve heartbeat: flushes are the "steps"; with NO work in flight
+    # (every submitted request answered — not merely an empty queue,
+    # which would also mask a dispatch hung inside the device call) the
+    # clock is touch()ed so an idle endpoint is never declared wedged —
+    # only pending-but-stalled requests are
+    hb_ref: dict = {}
+
+    def sample() -> dict:
+        s = engine.heartbeat_sample()
+        in_flight = (s.get("serve_requests", 0)
+                     - s.get("serve_responses", 0) - s.get("serve_errors", 0))
+        if in_flight <= 0 and "hb" in hb_ref:
+            hb_ref["hb"].touch()
+        return s
+
+    hb = Heartbeat(os.path.join(cfg.train.log_dir, "heartbeat.json"),
+                   period_s=cfg.obs.heartbeat_period_s,
+                   watchdog_factor=cfg.obs.watchdog_factor,
+                   watchdog_min_s=cfg.obs.watchdog_min_s,
+                   sample=sample)
+    hb_ref["hb"] = hb
+    engine.flush_hook = hb.beat
+    httpd = build_server(cfg, engine)
+    host, port = httpd.server_address[:2]
+    print(json.dumps({"serving": f"http://{host}:{port}",
+                      "buckets": [list(b) for b in engine.buckets],
+                      "max_batch": engine.max_batch,
+                      "warm": warm.get("cache")}), flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        if own_engine:
+            engine.close()
+        _log_serve_summary(cfg, engine)
+        hb.close()
+    return 0
+
+
+def _log_serve_summary(cfg: ExperimentConfig, engine: InferenceEngine) -> None:
+    """Append one kind="serve" record (the final serve_* counters) to the
+    run's metrics.jsonl so `deepof_tpu analyze` surfaces serving
+    activity alongside training history."""
+    try:
+        os.makedirs(cfg.train.log_dir, exist_ok=True)
+        rec = {"kind": "serve", "step": 0, "time": time.time()}
+        rec.update(engine.stats())
+        with open(os.path.join(cfg.train.log_dir, "metrics.jsonl"), "a") as f:
+            f.write(json.dumps(rec, allow_nan=False) + "\n")
+    except OSError:
+        pass  # a read-only log tree must not fail the serve exit path
+
+
+# ------------------------------------------------------------- offline
+
+
+def _enumerate_pairs(input_path: str) -> list[tuple[str, str]]:
+    """Consecutive frame pairs from a directory of images (sorted) —
+    the directory half of offline mode."""
+    names = sorted(n for n in os.listdir(input_path)
+                   if n.lower().endswith(_IMAGE_EXTS))
+    paths = [os.path.join(input_path, n) for n in names]
+    if len(paths) < 2:
+        raise SystemExit(f"offline serve: need >= 2 frames in {input_path!r}, "
+                         f"found {len(paths)}")
+    return list(zip(paths, paths[1:]))
+
+
+def _video_rows(path: str, engine: InferenceEngine):
+    """Decoded consecutive-pair rows from a video file. Decode is
+    inherently sequential (cv2.VideoCapture), so rows stream from the
+    caller's thread; the engine still batches dispatches behind it."""
+    import cv2
+
+    from .buckets import pick_bucket, prepare_pair
+
+    cap = cv2.VideoCapture(path)
+    if not cap.isOpened():
+        raise SystemExit(f"offline serve: cannot open video {path!r}")
+    try:
+        ok, prev = cap.read()
+        idx = 0
+        while ok:
+            ok, nxt = cap.read()
+            if not ok:
+                break
+            native_hw = (prev.shape[0], prev.shape[1])
+            bucket = pick_bucket(native_hw, engine.buckets)
+            yield idx, prepare_pair(prev, nxt, bucket, engine.mean), \
+                bucket, native_hw
+            prev = nxt
+            idx += 1
+    finally:
+        cap.release()
+
+
+def run_offline(cfg: ExperimentConfig, input_path: str, out_dir: str,
+                write_png: bool = True, engine: InferenceEngine | None = None,
+                model_params=None) -> dict:
+    """High-throughput offline inference over a frame directory or video
+    file: decode/preprocess on the data/pipeline.py worker pool
+    (directories), stage through prefetch.py, batch through the engine,
+    overlap output writes with in-flight inference. Returns the summary
+    dict the CLI prints."""
+    from collections import deque
+
+    from ..predict import write_outputs
+
+    os.makedirs(out_dir, exist_ok=True)
+    own_engine = engine is None
+    if own_engine:
+        engine = InferenceEngine(cfg, model_params=model_params)
+    t0 = time.perf_counter()
+    written: list[str] = []
+    n_pairs = n_err = 0
+    try:
+        engine.warm()
+        if os.path.isfile(input_path) \
+                and input_path.lower().endswith(_VIDEO_EXTS):
+            submissions = ((f"frame{idx:06d}",
+                            engine.submit_prepared(x, bucket, native_hw))
+                           for idx, x, bucket, native_hw
+                           in _video_rows(input_path, engine))
+        else:
+            submissions = _submit_directory(
+                cfg, engine, _enumerate_pairs(input_path))
+        # bounded outstanding-futures window (resolved futures hold full
+        # native-resolution flows): writes overlap in-flight inference,
+        # host memory stays O(window) however long the sweep is
+        window = max(4 * engine.max_batch, 16)
+        buf: deque = deque()
+
+        def drain_one() -> None:
+            nonlocal n_err
+            stem, fut = buf.popleft()
+            try:
+                flow = fut.result()["flow"]
+            except ServeError as e:
+                n_err += 1
+                print(json.dumps({"request": stem, **e.payload()}),
+                      flush=True)
+                return
+            written.extend(write_outputs(out_dir, stem, flow,
+                                         write_png=write_png))
+
+        try:
+            for sub in submissions:
+                n_pairs += 1
+                buf.append(sub)
+                if len(buf) >= window:
+                    drain_one()
+            while buf:
+                drain_one()
+        finally:
+            close = getattr(submissions, "close", None)
+            if close is not None:  # release the generator's pipeline
+                close()
+    finally:
+        if own_engine:
+            engine.close()
+        _log_serve_summary(cfg, engine)
+    wall = time.perf_counter() - t0
+    stats = engine.stats()
+    return {"pairs": n_pairs, "errors": n_err, "written": len(written),
+            "wall_s": round(wall, 3),
+            "pairs_per_s": round((n_pairs - n_err) / wall, 3)
+            if wall > 0 else None,
+            **{k: stats[k] for k in ("serve_batches", "serve_occupancy_mean",
+                                     "serve_latency_p50_ms",
+                                     "serve_latency_p99_ms")}}
+
+
+def _submit_directory(cfg: ExperimentConfig, engine: InferenceEngine,
+                      pairs: list[tuple[str, str]]):
+    """Yield (stem, future) for a directory's pairs through the parallel
+    host input path: `data/pipeline.py` workers decode+preprocess rows
+    out-of-order (delivered in order), a `data/prefetch.py` Prefetcher
+    keeps a bounded ready-queue ahead of the submit loop, and the engine
+    batches behind both. A pair whose decode fails becomes a per-index
+    structured error row — one corrupt frame fails one request, never
+    the sweep. Lazy by design: the consumer's bounded window, not the
+    pair count, bounds in-flight memory."""
+    from ..data.datasets import _imread_bgr
+    from ..data.pipeline import InputPipeline
+    from ..data.prefetch import Prefetcher
+    from ..predict import output_stem
+    from .buckets import pick_bucket, prepare_pair
+
+    def make_row(i: int) -> dict:
+        # the pipeline's index stream is unbounded (workers run ahead of
+        # the delivery cursor); indices past the work list are cheap
+        # padding rows that are prefetched but never consumed
+        if i >= len(pairs):
+            return {"pad": True}
+        src, tgt = pairs[i]
+        try:
+            prev = _imread_bgr(src)
+            nxt = _imread_bgr(tgt)
+            native_hw = (prev.shape[0], prev.shape[1])
+            bucket = pick_bucket(native_hw, engine.buckets)
+            return {"x": prepare_pair(prev, nxt, bucket, engine.mean),
+                    "bucket": bucket, "native_hw": native_hw}
+        except Exception as e:  # noqa: BLE001 - contained per-index
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    workers = max(int(cfg.serve.workers), 0)
+    pipeline = InputPipeline(make_row, num_workers=workers,
+                             retries=cfg.resilience.pipeline_retries)
+    it = iter(pipeline)
+    prefetch = Prefetcher(lambda: next(it), depth=max(cfg.data.prefetch, 1))
+    try:
+        for i, (src, _) in enumerate(pairs):
+            row = prefetch.get()
+            stem = output_stem(src, i, True)
+            if "error" in row:
+                yield (stem, _failed_future(
+                    ServeError("bad_input", row["error"], i)))
+                continue
+            yield (stem, engine.submit_prepared(
+                row["x"], row["bucket"], row["native_hw"]))
+    finally:
+        prefetch.close()
+        pipeline.close()
+
+
+def _failed_future(err: ServeError):
+    from concurrent.futures import Future
+
+    fut: Future = Future()
+    fut.set_exception(err)
+    return fut

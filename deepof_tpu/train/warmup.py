@@ -254,3 +254,53 @@ def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
             out["eval_compile_s"] = round(time.perf_counter() - t0, 3)
     out["cache"] = d.stats()
     return out
+
+
+def warmup_serve(cfg: ExperimentConfig) -> dict:
+    """AOT-compile the serve bucket ladder into the persistent cache
+    (`warmup --serve`): one inference executable per configured shape
+    bucket, lowered exactly as `serve/engine.py:_executable` lowers at
+    runtime (shared `make_raw_forward` + `serve_avals`), so a later
+    engine's first request per bucket LOADS instead of compiling — zero
+    first-request XLA across the ladder (pinned in tests/test_serve.py).
+
+    No checkpoint needed: params enter as ShapeDtypeStructs from an
+    eval_shape of model.init — warmup compiles executables for a
+    *config*, ahead of any trained weights existing.
+    """
+    import jax.numpy as jnp
+
+    from ..serve.buckets import resolve_buckets
+    from ..serve.engine import (PAIR_CHANNELS, build_serve_model,
+                                make_raw_forward, serve_avals)
+
+    enable_for_config(cfg)
+    model = build_serve_model(cfg)
+    buckets = resolve_buckets(cfg)
+    max_batch = max(cfg.serve.max_batch, 1)
+    fwd = jax.jit(make_raw_forward(model))
+
+    out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
+                           "backend": jax.default_backend(),
+                           "cache_dir": jax.config.jax_compilation_cache_dir,
+                           "buckets": []}
+    # everything inside the delta must be the bucket executables and
+    # nothing else: abstract init (eval_shape over ShapeDtypeStructs
+    # executes nothing) keeps helper compiles (zeros fills, PRNG setup)
+    # from polluting the hit/miss pin
+    key_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+    with cache_delta() as d:
+        for bucket in buckets:
+            h, w = bucket
+            variables_sds = jax.eval_shape(
+                model.init, key_sds,
+                jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS), jnp.float32))
+            params_sds, x_sds = serve_avals(variables_sds["params"], bucket,
+                                            max_batch)
+            t0 = time.perf_counter()
+            fwd.lower(params_sds, x_sds).compile()
+            out["buckets"].append(
+                {"bucket": [h, w],
+                 "compile_s": round(time.perf_counter() - t0, 3)})
+    out["cache"] = d.stats()
+    return out

@@ -1,0 +1,195 @@
+"""Headless serving benchmark: requests/s + latency percentiles against
+a local InferenceEngine (no HTTP, no checkpoint needed).
+
+Drives the dynamic micro-batcher with a configurable open-loop arrival
+process (one request every --gap-ms) and reports one JSON line (same
+convention as bench.py): throughput, p50/p99 latency, dispatch count,
+mean batch occupancy. Two executors:
+
+  --fake (default): a deterministic timed executor — sleeps --exec-ms
+      per DISPATCH (batch-size independent, like a device whose forward
+      is latency-bound) and computes flow as a cheap function of the
+      input. Measures the batcher itself; runs anywhere in
+      milliseconds; the fast-tier schema smoke test uses this.
+  --real: builds the config's model with randomly initialized params
+      (or restores --log-dir's newest verified checkpoint when given)
+      and measures true end-to-end engine throughput.
+
+--serial additionally runs the identical workload through a max_batch=1
+engine (the serial per-pair dispatch pattern) and reports the speedup —
+the dynamic-batching win as one number.
+
+Run: python tools/serve_bench.py [--requests 64] [--gap-ms 1]
+     [--max-batch 8] [--timeout-ms 10] [--exec-ms 10] [--serial]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from deepof_tpu.core.config import get_config  # noqa: E402
+from deepof_tpu.serve.engine import InferenceEngine  # noqa: E402
+
+#: keys every serve_bench JSON result carries (schema smoke test)
+REQUIRED_KEYS = (
+    "mode", "requests", "errors", "wall_s", "requests_per_s",
+    "latency_p50_ms", "latency_p99_ms", "dispatches", "occupancy_mean",
+    "max_batch", "timeout_ms", "gap_ms",
+)
+
+
+def _bench_cfg(bucket: tuple[int, int], max_batch: int, timeout_ms: float,
+               log_dir: str | None):
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=bucket, gt_size=bucket),
+        serve=dataclasses.replace(cfg.serve, max_batch=max_batch,
+                                  batch_timeout_ms=timeout_ms),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e4, 1e4)))
+    if log_dir:
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train,
+                                                    log_dir=log_dir))
+    return cfg
+
+
+def make_fake_forward(exec_ms: float):
+    """Deterministic timed executor: sleep per dispatch, flow = scaled
+    channel difference of the input pair (content-dependent, so output
+    equality across runs is a real check)."""
+
+    def forward(bucket, x):
+        time.sleep(max(exec_ms, 0.0) / 1e3)
+        return np.stack([x[..., 0] - x[..., 3], x[..., 1] - x[..., 4]],
+                        axis=-1).astype(np.float32)
+
+    return forward
+
+
+def _real_model_params(cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.serve.engine import build_serve_model
+
+    model = build_serve_model(cfg)
+    h, w = cfg.data.image_size
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, h, w, 3 * cfg.data.time_step)))
+    return model, variables["params"]
+
+
+def run_workload(engine: InferenceEngine, requests: list, gap_ms: float):
+    """Open-loop arrival: submit with a fixed inter-arrival gap, then
+    wait for every future. Returns (wall_s, errors, results)."""
+    t0 = time.perf_counter()
+    futures = []
+    for prev, nxt in requests:
+        futures.append(engine.submit(prev, nxt))
+        if gap_ms > 0:
+            time.sleep(gap_ms / 1e3)
+    results, errors = [], 0
+    for fut in futures:
+        try:
+            results.append(fut.result(timeout=120.0))
+        except Exception:  # noqa: BLE001 - counted, benchmark continues
+            errors += 1
+            results.append(None)
+    return time.perf_counter() - t0, errors, results
+
+
+def serve_bench(requests: int = 64, gap_ms: float = 1.0, max_batch: int = 8,
+                timeout_ms: float = 10.0, exec_ms: float = 10.0,
+                bucket: tuple[int, int] = (64, 64),
+                native_hw: tuple[int, int] = (48, 96), fake: bool = True,
+                log_dir: str | None = None, serial: bool = False) -> dict:
+    cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    rng = np.random.RandomState(0)
+    pairs = [(rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8),
+              rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8))
+             for _ in range(max(int(requests), 1))]
+
+    if fake:
+        make_engine = lambda c: InferenceEngine(  # noqa: E731
+            c, forward_fn=make_fake_forward(exec_ms))
+        mode = "fake"
+    else:
+        model_params = (_real_model_params(cfg) if not log_dir else None)
+        make_engine = lambda c: InferenceEngine(  # noqa: E731
+            c, model_params=model_params)
+        mode = "real"
+
+    with make_engine(cfg) as engine:
+        engine.warm()
+        wall, errors, _ = run_workload(engine, pairs, gap_ms)
+        stats = engine.stats()
+
+    out = {
+        "mode": mode, "requests": len(pairs), "errors": errors,
+        "wall_s": round(wall, 4),
+        "requests_per_s": round((len(pairs) - errors) / wall, 2),
+        "latency_p50_ms": stats["serve_latency_p50_ms"],
+        "latency_p99_ms": stats["serve_latency_p99_ms"],
+        "dispatches": stats["serve_batches"],
+        "occupancy_mean": stats["serve_occupancy_mean"],
+        "max_batch": max_batch, "timeout_ms": timeout_ms, "gap_ms": gap_ms,
+        "exec_ms": exec_ms if fake else None,
+        "bucket": list(bucket),
+    }
+    if serial:
+        scfg = cfg.replace(serve=dataclasses.replace(cfg.serve, max_batch=1))
+        with make_engine(scfg) as eng1:
+            eng1.warm()
+            swall, serr, _ = run_workload(eng1, pairs, gap_ms)
+        out["serial_wall_s"] = round(swall, 4)
+        out["serial_requests_per_s"] = round((len(pairs) - serr) / swall, 2)
+        out["speedup_vs_serial"] = round(swall / wall, 2) if wall > 0 else None
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="serve_bench")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--gap-ms", type=float, default=1.0)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--timeout-ms", type=float, default=10.0)
+    ap.add_argument("--exec-ms", type=float, default=10.0,
+                    help="fake mode: per-dispatch executor latency")
+    ap.add_argument("--bucket", default="64x64", metavar="HxW")
+    ap.add_argument("--native", default="48x96", metavar="HxW",
+                    help="native resolution of the synthetic requests")
+    ap.add_argument("--real", action="store_true",
+                    help="real model forward instead of the fake executor")
+    ap.add_argument("--log-dir", default=None,
+                    help="real mode: restore this run's newest verified "
+                         "checkpoint instead of random init")
+    ap.add_argument("--serial", action="store_true",
+                    help="also run max_batch=1 and report the speedup")
+    args = ap.parse_args(argv)
+
+    def hw(spec):
+        h, w = spec.lower().split("x")
+        return (int(h), int(w))
+
+    res = serve_bench(requests=args.requests, gap_ms=args.gap_ms,
+                      max_batch=args.max_batch, timeout_ms=args.timeout_ms,
+                      exec_ms=args.exec_ms, bucket=hw(args.bucket),
+                      native_hw=hw(args.native), fake=not args.real,
+                      log_dir=args.log_dir, serial=args.serial)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
