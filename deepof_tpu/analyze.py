@@ -117,6 +117,14 @@ def _serve_counters(rec: dict) -> dict:
             if k.startswith("serve_") and v is not None}
 
 
+def _fleet_counters(rec: dict) -> dict:
+    """`fleet_*` counters from one record or heartbeat sample (the
+    serving-fleet block: replica states, evictions/respawns, circuit
+    breaker, failover retries, shed counts)."""
+    return {k[len("fleet_"):]: v for k, v in rec.items()
+            if k.startswith("fleet_") and v is not None}
+
+
 def summarize(records: list[dict]) -> dict:
     by_kind: dict[str, list[dict]] = defaultdict(list)
     for r in records:
@@ -169,8 +177,13 @@ def summarize(records: list[dict]) -> dict:
     serves = by_kind.get("serve", [])
     if serves:
         # cumulative counters: the newest serve record carries the whole
-        # serving session (server.py appends one at shutdown)
-        out["serve"] = _serve_counters(serves[-1])
+        # serving session (server.py / fleet.py append one at shutdown)
+        serve = _serve_counters(serves[-1])
+        if serve:
+            out["serve"] = serve
+        fleet = _fleet_counters(serves[-1])
+        if fleet:
+            out["fleet"] = fleet
 
     warns = by_kind.get("warn", [])
     if warns:
@@ -281,10 +294,23 @@ def tail_summary(log_dir: str, recent: int = 10,
         serve = _serve_counters(hb)
         if serve:
             out["serve"] = serve
+        # a fleet supervisor's heartbeat carries the live fleet_* block
+        # (replica states, evictions/respawns/broken, failovers, shed) —
+        # `tail` exits 4 when it shows evictions or a broken replica
+        fleet = _fleet_counters(hb)
+        if fleet:
+            out["fleet"] = fleet
 
     serves = [r for r in records if r.get("kind") == "serve"]
-    if serves and "serve" not in out:
-        out["serve"] = _serve_counters(serves[-1])
+    if serves:
+        if "serve" not in out:
+            serve = _serve_counters(serves[-1])
+            if serve:
+                out["serve"] = serve
+        if "fleet" not in out:
+            fleet = _fleet_counters(serves[-1])
+            if fleet:
+                out["fleet"] = fleet
     return out
 
 

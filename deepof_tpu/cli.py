@@ -28,7 +28,8 @@ import json
 import os
 import sys
 
-from .core.config import PRESETS, ExperimentConfig, get_config
+from .core.config import (PRESETS, ExperimentConfig, config_from_dict,
+                          get_config)
 
 
 def _parse_value(raw: str):
@@ -60,7 +61,13 @@ def _apply_override(cfg: ExperimentConfig, dotted: str, raw: str) -> ExperimentC
 
 
 def _build_cfg(args) -> ExperimentConfig:
-    cfg = get_config(args.preset)
+    if getattr(args, "config_json", None):
+        # the fleet's parent->replica handoff: the exact serialized
+        # config tree, not a preset re-derivation (--set still wins)
+        with open(args.config_json) as f:
+            cfg = config_from_dict(json.load(f))
+    else:
+        cfg = get_config(args.preset)
     if args.model:
         cfg = cfg.replace(model=args.model)
     if args.data_path:
@@ -168,6 +175,18 @@ def main(argv=None) -> int:
                        help="offline mode: output directory for "
                             ".flo/.png results")
     p_srv.add_argument("--no-png", action="store_true")
+    p_srv.add_argument("--replicas", type=int, default=None,
+                       help="self-healing serving fleet (DESIGN.md "
+                            "\"Fleet\"): supervise N engine-replica "
+                            "subprocesses behind a health-gated router "
+                            "with bucket-affinity routing, failover "
+                            "retries, load shedding, and automatic "
+                            "evict/respawn of wedged or crashed "
+                            "replicas. Overrides serve.fleet.replicas; "
+                            "<= 1 keeps single-process serving")
+    p_srv.add_argument("--config-json", default=None,
+                       help=argparse.SUPPRESS)  # fleet-internal: replica
+    #                      processes load the supervisor's exact config
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     p_bench.add_argument("--model", default="inception_v3")
@@ -251,6 +270,13 @@ def main(argv=None) -> int:
             hb = summary.get("heartbeat") or {}
             if hb.get("wedged"):
                 return 3
+            # rc 4 when a serving fleet self-healed (evictions) or gave
+            # up on a replica (circuit breaker): the fleet may be
+            # serving again, but an operator must see that replicas
+            # were sick — the counters are cumulative by design
+            fleet = summary.get("fleet") or {}
+            if fleet.get("broken") or fleet.get("evictions"):
+                return 4
             if not args.follow:
                 return 0
             import time as _time
@@ -328,13 +354,23 @@ def main(argv=None) -> int:
         if (args.input is None) != (args.out is None):
             raise SystemExit("serve: offline mode needs BOTH --input and "
                              "--out (neither = HTTP server mode)")
+        replicas = (args.replicas if args.replicas is not None
+                    else cfg.serve.fleet.replicas)
         if args.input is not None:
+            if replicas and replicas > 1:
+                raise SystemExit("serve: --replicas is HTTP-fleet only "
+                                 "(offline mode already parallelizes via "
+                                 "serve.workers)")
             from .serve.server import run_offline
 
             res = run_offline(cfg, args.input, args.out,
                               write_png=not args.no_png)
             print(json.dumps(res))
             return 0
+        if replicas and replicas > 1:
+            from .serve.fleet import run_fleet
+
+            return run_fleet(cfg, replicas)
         from .serve.server import run_server
 
         return run_server(cfg)

@@ -275,6 +275,73 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Self-healing serving fleet (serve/fleet.py + serve/router.py,
+    DESIGN.md "Fleet"): N supervised engine-replica subprocesses behind
+    a health-gated router. The supervisor evicts stale/wedged replicas
+    (SIGTERM then SIGKILL), respawns with exponential backoff, and stops
+    respawning a crash-looping replica (circuit breaker); the router
+    keeps bucket-affinity executables hot, replays failed requests on
+    healthy siblings, and sheds load with structured 503s when every
+    replica is saturated."""
+
+    # replica count behind the router; 0/1 = single-process serve (the
+    # `serve --replicas N` CLI flag overrides this)
+    replicas: int = 0
+    # supervisor health-poll cadence
+    poll_s: float = 1.0
+    # a READY replica whose heartbeat.json is older than this is evicted
+    # (the serve heartbeat rewrites every obs.heartbeat_period_s, so
+    # size this to several periods)
+    stale_after_s: float = 15.0
+    # supervisor-side stall detector, independent of the replica's OWN
+    # wedge watchdog (which arms only after 3 completed flushes — a
+    # dispatch that hangs on flush 1 or 2 would otherwise keep a fresh,
+    # never-wedged heartbeat forever): evict a replica whose heartbeat
+    # shows requests in flight but no completion for this long. Safe
+    # against cold-start false positives because engine.warm()
+    # compiles the whole bucket ladder BEFORE the replica announces, so
+    # a dispatch slower than this is a hang, not a compile. Must exceed
+    # the worst-case honest dispatch time; 0 disables.
+    stall_after_s: float = 60.0
+    # how long an announced replica may take to start listening before
+    # the spawn is declared failed (covers model restore + warm compile)
+    spawn_timeout_s: float = 180.0
+    # eviction: SIGTERM first (graceful drain), SIGKILL after this grace
+    term_grace_s: float = 5.0
+    # respawn backoff: backoff_s * 2^(consecutive fast failures), capped
+    backoff_s: float = 0.5
+    backoff_max_s: float = 30.0
+    # circuit breaker: this many CONSECUTIVE fast failures (died within
+    # healthy_after_s of becoming ready, or never became ready) stops
+    # respawning the replica — a crash loop burns backoff forever and
+    # masks the real defect; surviving replicas keep serving
+    crash_loop_threshold: int = 3
+    # alive this long after ready resets the fast-failure counter
+    healthy_after_s: float = 5.0
+    # failover: how many times ONE request may be replayed on a
+    # different replica after a transport error / replica 5xx (requests
+    # are pure, so replay is idempotent by construction)
+    failover_retries: int = 2
+    # router-side per-replica in-flight cap: when EVERY healthy replica
+    # is at this bound the request is shed with a structured 503
+    # instead of queuing unboundedly at the router
+    max_in_flight: int = 32
+    # per-replica in-flight level above which the router spills a
+    # request past its affinity replica to the next healthy one.
+    # 0 = auto (serve.max_batch): below one full batch the affinity
+    # replica keeps its executables hot; above it, spreading wins.
+    spill_in_flight: int = 0
+    # per-attempt proxy timeout (a wedged replica's request times out
+    # here and replays on a sibling; the watchdog/evictor handles the
+    # replica itself)
+    proxy_timeout_s: float = 30.0
+    # graceful shutdown: stop admission, wait this long for in-flight
+    # requests to flush before reaping replicas
+    drain_timeout_s: float = 10.0
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving subsystem (deepof_tpu/serve/, DESIGN.md
     "Serving"): the dynamic micro-batching engine, the shape-bucket
@@ -309,6 +376,15 @@ class ServeConfig:
     # workers for the data/pipeline.py pool that feeds the engine.
     # 0 = decode inline on the submit thread.
     workers: int = 0
+    # Testing/bench executor: when set, the engine replaces the model
+    # with the deterministic fake timed executor (sleeps this many ms
+    # per dispatch, flow = channel difference) — no checkpoint, no jax.
+    # This is how fleet tests and `serve_bench --fleet` run replica
+    # subprocesses cheaply; None = the real restored model.
+    fake_exec_ms: float | None = None
+    # Self-healing replica fleet (serve/fleet.py); replicas=0 keeps the
+    # single-process serve path.
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 @dataclass(frozen=True)
@@ -462,3 +538,52 @@ PRESETS: dict[str, ExperimentConfig] = {
 def get_config(name: str, **overrides: Any) -> ExperimentConfig:
     cfg = PRESETS[name]
     return cfg.replace(**overrides) if overrides else cfg
+
+
+# --- JSON round-trip: the fleet's parent->replica config handoff ---
+
+
+def _tupleize(value: Any) -> Any:
+    """JSON arrays -> the tuples the frozen config tree uses (nested:
+    serve.buckets round-trips as a tuple of tuples)."""
+    if isinstance(value, list):
+        return tuple(_tupleize(v) for v in value)
+    return value
+
+
+def _from_dict(cls: type, d: dict, path: str = "") -> Any:
+    import typing
+
+    unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+    if unknown:
+        where = path or cls.__name__
+        raise ValueError(
+            f"config_from_dict: unknown field(s) {sorted(unknown)} in "
+            f"{where}")
+    hints = typing.get_type_hints(cls)
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue  # absent fields keep their defaults (older dumps)
+        value = d[f.name]
+        hint = hints.get(f.name)
+        if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+            value = _from_dict(hint, value, f"{path}.{f.name}" if path
+                               else f.name)
+        else:
+            value = _tupleize(value)
+        kwargs[f.name] = value
+    return cls(**kwargs)
+
+
+def config_from_dict(d: dict) -> ExperimentConfig:
+    """Inverse of `dataclasses.asdict` + JSON for the config tree:
+    rebuilds the nested frozen dataclasses and re-tuples JSON arrays.
+    `serve/fleet.py` serializes the parent's exact config to each
+    replica's `config.json` and the replica loads it via the CLI's
+    `serve --config-json` — replicas must serve the same ladder and the
+    same fault schedule as the supervisor intended, not a preset
+    re-derivation. Unknown keys are rejected AT EVERY LEVEL (a typo'd
+    field must not silently become its default); missing keys keep
+    their defaults so older dumps load."""
+    return _from_dict(ExperimentConfig, d)

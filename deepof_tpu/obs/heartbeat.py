@@ -83,6 +83,11 @@ class Heartbeat:
         (MetricsLogger-shaped: lands in metrics.jsonl as a warn record).
     tracer: optional obs.trace.Tracer flushed when a wedge fires.
     on_wedge: optional (stack_dump_str) hook after the dump is logged.
+    devmem: sample per-device memory on the background thread. False
+        keeps the process jax-free (device_memory_summary imports jax
+        and touches the backend) — fleet supervisors and fake-executor
+        replicas beat without ever initializing an accelerator; the
+        dev_mem_* keys stay present as nulls so the schema is stable.
     """
 
     def __init__(self, path: str, period_s: float = 5.0,
@@ -90,7 +95,7 @@ class Heartbeat:
                  sample: Callable[[], dict] | None = None,
                  log: Callable[[int, str], None] | None = None,
                  tracer=None, on_wedge: Callable[[str], None] | None = None,
-                 window: int = 64):
+                 window: int = 64, devmem: bool = True):
         self.path = path
         self._period = max(float(period_s), 0.05)
         self._factor = max(float(watchdog_factor), 1.0)
@@ -115,11 +120,13 @@ class Heartbeat:
         # only stales the cached values; the watchdog keeps polling.
         self._devmem: dict = {"dev_mem_bytes_in_use": None,
                               "dev_mem_peak_bytes": None}
-        self._sampler = threading.Thread(target=self._sample_devices,
-                                         daemon=True, name="obs-devmem")
+        self._sampler = None
+        if devmem:
+            self._sampler = threading.Thread(target=self._sample_devices,
+                                             daemon=True, name="obs-devmem")
+            self._sampler.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="obs-heartbeat")
-        self._sampler.start()
         self._thread.start()
 
     # ------------------------------------------------------------ inputs
@@ -235,5 +242,7 @@ class Heartbeat:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=self._period + 5.0)
-        # a sampler wedged inside a hung backend call is abandoned (daemon)
-        self._sampler.join(timeout=1.0)
+        if self._sampler is not None:
+            # a sampler wedged inside a hung backend call is abandoned
+            # (daemon)
+            self._sampler.join(timeout=1.0)

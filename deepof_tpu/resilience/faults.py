@@ -74,6 +74,20 @@ class FaultConfig:
     dispatch_at: tuple[int, ...] = ()
     ckpt_truncate_at: tuple[int, ...] = ()
     ckpt_corrupt_at: tuple[int, ...] = ()
+    # replica-level acting sites (serving fleet chaos, serve/server.py
+    # install_replica_faults): the site index is the REPLICA index (the
+    # fleet exports DEEPOF_TPU_REPLICA to each subprocess), and the
+    # fault arms once that replica has completed `replica_fault_after`
+    # responses — "mid-load" by construction. replica_crash = SIGKILL
+    # the serving process (kill -9); replica_wedge = the next dispatch
+    # blocks forever (a hung device call — exactly what the serve
+    # heartbeat watchdog exists to flag). Each replica process builds a
+    # fresh injector from config, so a respawned replica re-arms the
+    # same schedule: a crash-looping replica is one `replica_crash_at`
+    # entry with a small replica_fault_after.
+    replica_crash_at: tuple[int, ...] = ()
+    replica_wedge_at: tuple[int, ...] = ()
+    replica_fault_after: int = 8
     # how many checks of one (site, index) fault before it recovers:
     # 1 = transient (first retry succeeds); data_retries + 1 = exhausts
     # the retry budget and forces quarantine + substitution; a large
@@ -82,7 +96,8 @@ class FaultConfig:
 
 
 _SITES = ("decode", "assemble", "fetch", "ckpt_save", "ckpt_restore",
-          "dispatch", "ckpt_truncate", "ckpt_corrupt")
+          "dispatch", "ckpt_truncate", "ckpt_corrupt",
+          "replica_crash", "replica_wedge")
 
 
 def _u01(seed: int, site: str, index: int) -> float:

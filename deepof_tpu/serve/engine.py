@@ -119,6 +119,24 @@ def make_raw_forward(model) -> Callable:
     return fwd
 
 
+def make_fake_forward(exec_ms: float) -> Callable:
+    """Deterministic timed executor standing in for the model: sleeps
+    `exec_ms` per DISPATCH (batch-size independent, like a device whose
+    forward is latency-bound) and computes flow as the scaled channel
+    difference of the input pair — content-dependent, so output equality
+    across runs/replicas is a real check. The batcher tests,
+    `tools/serve_bench.py`, and fleet replica subprocesses
+    (`serve.fake_exec_ms`) all share this one definition: no checkpoint,
+    no jax import."""
+
+    def forward(bucket, x):
+        time.sleep(max(exec_ms, 0.0) / 1e3)
+        return np.stack([x[..., 0] - x[..., 3], x[..., 1] - x[..., 4]],
+                        axis=-1).astype(np.float32)
+
+    return forward
+
+
 #: Serving is pair-based: prepare_pair always concatenates exactly two
 #: preprocessed BGR frames, so every executable takes 6 input channels
 #: (multi-frame T-volume configs are a training shape, not a serving one).
@@ -165,6 +183,11 @@ class InferenceEngine:
                                      DATASET_MEANS["flyingchairs"])
         self.mean = mean
 
+        if (forward_fn is None and model_params is None
+                and cfg.serve.fake_exec_ms is not None):
+            # config-driven fake executor: how a fleet replica subprocess
+            # (which only gets a config.json) runs without a checkpoint
+            forward_fn = make_fake_forward(float(cfg.serve.fake_exec_ms))
         self._forward_custom = forward_fn is not None
         if self._forward_custom:
             self._forward = forward_fn

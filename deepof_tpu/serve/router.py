@@ -1,0 +1,360 @@
+"""Front-of-fleet router: health-gated, bucket-affine, failover-retrying.
+
+The fleet's public face (`deepof_tpu serve --replicas N`) is one stdlib
+HTTP endpoint with the same API as a single replica (`POST /v1/flow`,
+`GET /healthz`); behind it, `serve/fleet.py` supervises N engine-replica
+subprocesses and this router decides, per request, which of them serves.
+Three policies, in order:
+
+  Bucket affinity. Each replica keeps one AOT executable hot per shape
+  bucket; scattering a bucket's requests across replicas evicts those
+  executables from every replica's working set and splits its batches.
+  The router probes the request's image dimensions (header-only PNG/
+  JPEG/BMP parse — no full decode at the front), maps them to the
+  resolution ladder's bucket, and prefers replica `ladder_index % N` —
+  a fixed affinity map, so bucket b's traffic concentrates on one
+  replica while every replica can still serve any bucket.
+
+  Load spill + shedding. Affinity yields when the preferred replica
+  already has `fleet.spill_in_flight` requests in flight (default: one
+  full batch) — below that bound affinity keeps executables hot, above
+  it spreading wins. When EVERY healthy replica is at
+  `fleet.max_in_flight`, the request is shed with a structured 503
+  (`overloaded`) instead of queuing unboundedly at the front; no ready
+  replica at all is a 503 `unavailable`. Shedding is the router-side
+  face of the engine's queue backpressure: the per-replica in-flight
+  caps bound what a replica's bounded queue would otherwise absorb.
+
+  Failover replay. Engine requests are pure functions of their payload,
+  so replaying one is idempotent by construction. A transport error
+  (crashed replica: connection refused/reset), a proxy timeout (wedged
+  replica), or a replica-side 5xx replays the request on the next
+  healthy sibling, up to `fleet.failover_retries` times; transport
+  failures also poke the supervisor so eviction doesn't wait out a full
+  poll period. A request that exhausts its candidates gets a structured
+  502 — every admitted request resolves to a response or a structured
+  error, never silence.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import itertools
+import json
+import struct
+import threading
+import time
+from collections import defaultdict
+from typing import Callable
+
+from ..core.config import ExperimentConfig
+from .buckets import pick_bucket, resolve_buckets
+
+#: JPEG start-of-frame markers that carry the image dimensions (all SOF
+#: variants; C4/C8/CC are huffman/arithmetic tables, not frames).
+_JPEG_SOF = frozenset(range(0xC0, 0xD0)) - {0xC4, 0xC8, 0xCC}
+#: JPEG markers with no length field.
+_JPEG_BARE = frozenset(range(0xD0, 0xD9)) | {0x01}
+
+
+def probe_image_hw(data: bytes) -> tuple[int, int] | None:
+    """(H, W) from PNG/JPEG/BMP header bytes — no decoder, no cv2. None
+    when the format is unknown or the header is short/torn: affinity is
+    an optimization, so the caller falls back to unaffinitized routing
+    and lets the replica produce the real decode error."""
+    try:
+        if data[:8] == b"\x89PNG\r\n\x1a\n" and len(data) >= 24:
+            w, h = struct.unpack(">II", data[16:24])
+            return (int(h), int(w))
+        if data[:2] == b"\xff\xd8":  # JPEG: scan segments for a SOF
+            i = 2
+            while i + 9 < len(data):
+                if data[i] != 0xFF:
+                    return None  # lost sync: not a segment boundary
+                marker = data[i + 1]
+                if marker == 0xFF:  # fill byte
+                    i += 1
+                    continue
+                if marker in _JPEG_BARE:
+                    i += 2
+                    continue
+                if marker in _JPEG_SOF:
+                    h, w = struct.unpack(">HH", data[i + 5:i + 9])
+                    return (int(h), int(w))
+                (seg_len,) = struct.unpack(">H", data[i + 2:i + 4])
+                i += 2 + seg_len
+            return None
+        if data[:2] == b"BM" and len(data) >= 26:
+            w, h = struct.unpack("<ii", data[18:26])
+            return (abs(int(h)), abs(int(w)))  # h < 0 = top-down rows
+    except (struct.error, IndexError):
+        return None
+    return None
+
+
+class Router:
+    """See module docstring. Thread-safe: every HTTP handler thread
+    routes through one Router; the fleet's monitor mutates replica
+    state under the fleet lock and the router reads immutable
+    (idx, port) snapshots."""
+
+    def __init__(self, cfg: ExperimentConfig, fleet):
+        fc = cfg.serve.fleet
+        self.cfg = cfg
+        self.fleet = fleet
+        self.buckets = resolve_buckets(cfg)
+        self.retries = max(int(fc.failover_retries), 0)
+        self.max_in_flight = max(int(fc.max_in_flight), 1)
+        # spill is a preference bound INSIDE the hard cap — past the cap
+        # the only correct answer is shedding, never admission
+        self.spill = min(int(fc.spill_in_flight)
+                         or max(int(cfg.serve.max_batch), 1),
+                         self.max_in_flight)
+        self.timeout_s = max(float(fc.proxy_timeout_s), 0.1)
+        self.draining = False
+        # called with the cumulative response count after each success —
+        # the fleet heartbeat's beat() (run_fleet wires it)
+        self.beat_hook: Callable[[int], None] | None = None
+        self._lock = threading.Lock()
+        self._in_flight: dict[int, int] = defaultdict(int)
+        self._routed: dict[int, int] = defaultdict(int)
+        self._requests = 0
+        self._responses = 0
+        self._errors = 0
+        self._failovers = 0   # replays that ultimately produced a reply
+        self._retries = 0     # individual replay attempts
+        self._shed = 0        # 503 overloaded (all replicas saturated)
+        self._unavailable = 0  # 503 no ready replica at all
+        self._rr = itertools.count()  # unaffinitized round-robin cursor
+
+    # ---------------------------------------------------------- routing
+    def _preferred(self, bucket: tuple[int, int] | None) -> int:
+        if bucket is None or bucket not in self.buckets:
+            # probe failed / unknown shape: round-robin, not replica 0 —
+            # an unprobeable workload must still spread across the fleet
+            return next(self._rr) % max(self.fleet.size, 1)
+        return self.buckets.index(bucket) % max(self.fleet.size, 1)
+
+    def _acquire(self, bucket, tried: set):
+        """Reserve an in-flight slot on the best candidate. Returns
+        (replica_snapshot, None) or (None, reason) where reason is
+        'unavailable' (no ready replica), 'overloaded' (all ready ones
+        saturated), or 'exhausted' (every ready replica already tried —
+        failover has nowhere left to replay)."""
+        ready = self.fleet.ready_replicas()
+        if not ready:
+            return None, "unavailable"
+        cand = [r for r in ready if r.idx not in tried]
+        if not cand:
+            return None, "exhausted"
+        pref = self._preferred(bucket)
+        n = max(self.fleet.size, 1)
+        cand.sort(key=lambda r: (r.idx - pref) % n)
+        with self._lock:
+            pick = None
+            for r in cand:  # affinity order while under the spill bound
+                if self._in_flight[r.idx] < self.spill:
+                    pick = r
+                    break
+            if pick is None:  # all past spill: least-loaded wins
+                pick = min(cand, key=lambda r: self._in_flight[r.idx])
+                if self._in_flight[pick.idx] >= self.max_in_flight:
+                    return None, "overloaded"
+            self._in_flight[pick.idx] += 1
+            self._routed[pick.idx] += 1
+        return pick, None
+
+    def _release(self, idx: int) -> None:
+        with self._lock:
+            self._in_flight[idx] -= 1
+
+    def _proxy(self, replica, path: str, body: bytes, ctype: str):
+        conn = http.client.HTTPConnection(self.fleet.host, replica.port,
+                                          timeout=self.timeout_s)
+        try:
+            conn.request("POST", path, body,
+                         {"Content-Type": ctype or "application/json"})
+            resp = conn.getresponse()
+            return (resp.status, resp.read(),
+                    resp.getheader("Content-Type") or "application/json")
+        finally:
+            conn.close()
+
+    def route_bucket(self, body: bytes) -> tuple[int, int] | None:
+        """Best-effort affinity bucket for a /v1/flow body: header-probe
+        the 'prev' image's dimensions without decoding it."""
+        try:
+            prev_b64 = json.loads(body).get("prev", "")
+            if not prev_b64:
+                return None
+            # the first ~KB of image bytes holds every header we parse;
+            # 4096 is 4-aligned, so a truncated prefix still decodes
+            raw = base64.b64decode(prev_b64[:4096])
+            hw = probe_image_hw(raw)
+            return pick_bucket(hw, self.buckets) if hw else None
+        except Exception:  # noqa: BLE001 - affinity is best-effort
+            return None
+
+    def handle_flow(self, path: str, body: bytes,
+                    ctype: str) -> tuple[int, bytes, str]:
+        """Route one POST /v1/flow: returns (status, payload, ctype) —
+        always; a request admitted here cannot be silently dropped."""
+        with self._lock:
+            self._requests += 1
+        bucket = self.route_bucket(body)
+        tried: set[int] = set()
+        last_error = None
+        for attempt in range(self.retries + 1):
+            replica, reason = self._acquire(bucket, tried)
+            if replica is None:
+                if reason == "exhausted":
+                    break  # fall through to the structured 502
+                with self._lock:
+                    self._errors += 1
+                    if reason == "overloaded":
+                        self._shed += 1
+                    else:
+                        self._unavailable += 1
+                msg = ("every replica is saturated — retry later"
+                       if reason == "overloaded"
+                       else "no healthy replica available")
+                return (503,
+                        json.dumps({"error": reason, "message": msg}).encode(),
+                        "application/json")
+            try:
+                status, payload, rtype = self._proxy(replica, path, body,
+                                                     ctype)
+            except Exception as e:  # noqa: BLE001 - transport = failover
+                self._release(replica.idx)
+                last_error = f"{type(e).__name__}: {e}"
+                tried.add(replica.idx)
+                with self._lock:
+                    self._retries += 1
+                # a dead/wedged replica shouldn't wait out a poll period
+                self.fleet.note_failure(replica.idx)
+                continue
+            self._release(replica.idx)
+            if status >= 500:  # replica-level failure: replay on a sibling
+                last_error = payload.decode("utf-8", "replace")[:200]
+                tried.add(replica.idx)
+                with self._lock:
+                    self._retries += 1
+                self.fleet.note_failure(replica.idx)
+                continue
+            with self._lock:
+                if attempt > 0:
+                    self._failovers += 1
+                if status < 400:
+                    self._responses += 1
+                    total = self._responses
+                else:
+                    self._errors += 1  # structured client error, relayed
+                    total = None
+            hook = self.beat_hook
+            if total is not None and hook is not None:
+                try:
+                    hook(total)
+                except Exception:  # noqa: BLE001 - obs never kills routing
+                    pass
+            return status, payload, rtype
+        with self._lock:
+            self._errors += 1
+        return (502, json.dumps({
+            "error": "replica_failed",
+            "message": f"request failed on {max(len(tried), 1)} replica(s); "
+                       f"last: {last_error}",
+            "attempts": max(len(tried), 1),
+        }).encode(), "application/json")
+
+    # ------------------------------------------------------------ stats
+    def in_flight_total(self) -> int:
+        with self._lock:
+            return sum(self._in_flight.values())
+
+    def stats(self) -> dict:
+        """The router's half of the fleet_* counter block (the fleet
+        heartbeat merges it with Fleet.stats())."""
+        with self._lock:
+            return {
+                "fleet_requests": self._requests,
+                "fleet_responses": self._responses,
+                "fleet_errors": self._errors,
+                "fleet_failovers": self._failovers,
+                "fleet_retries": self._retries,
+                "fleet_shed": self._shed,
+                "fleet_unavailable": self._unavailable,
+                "fleet_in_flight": sum(self._in_flight.values()),
+                "fleet_routed": {f"replica-{i}": n
+                                 for i, n in sorted(self._routed.items())},
+                "fleet_draining": self.draining,
+            }
+
+
+def build_router_server(cfg: ExperimentConfig, router: Router):
+    """The fleet's front HTTP server (same stdlib stack and API shape as
+    `serve/server.py`), bound to cfg.serve.host:port; returned unstarted
+    so callers drive serve_forever themselves."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def handle_error(self, request, client_address):
+            import sys
+
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (ConnectionError, TimeoutError)):
+                return
+            super().handle_error(request, client_address)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # obs owns visibility
+            pass
+
+        def _reply(self, status: int, body: bytes,
+                   ctype: str = "application/json") -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, status: int, payload: dict) -> None:
+            self._reply(status, json.dumps(payload).encode())
+
+        def do_GET(self):  # noqa: N802
+            if self.path in ("/healthz", "/stats"):
+                payload = {**router.fleet.stats(), **router.stats(),
+                           "replicas": router.fleet.describe(),
+                           "time": time.time()}
+                ok = payload.get("fleet_ready", 0) > 0 and not router.draining
+                self._reply(200 if ok else 503,
+                            json.dumps(payload).encode())
+            else:
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+
+        def do_POST(self):  # noqa: N802
+            if self.path not in ("/v1/flow", "/flow"):
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+                return
+            if router.draining:
+                self._reply_json(503, {"error": "draining",
+                                       "message": "fleet is shutting down"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+            except (ValueError, OSError) as e:
+                self._reply_json(400, {"error": "bad_request",
+                                       "message": f"{type(e).__name__}: {e}"})
+                return
+            status, payload, ctype = router.handle_flow(
+                self.path, body, self.headers.get("Content-Type", ""))
+            self._reply(status, payload, ctype)
+
+    return Server((cfg.serve.host, cfg.serve.port), Handler)

@@ -36,6 +36,8 @@ from __future__ import annotations
 import base64
 import json
 import os
+import signal
+import threading
 import time
 
 import numpy as np
@@ -46,6 +48,51 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 from ..core.config import ExperimentConfig
 from ..io.flo import flo_bytes
 from .engine import InferenceEngine, ServeError
+
+#: Replica identity exported by the fleet supervisor (serve/fleet.py) to
+#: each spawned serving subprocess — the index the replica-level fault
+#: sites key on, and the tag in the replica's announce line.
+REPLICA_ENV = "DEEPOF_TPU_REPLICA"
+
+
+def replica_index() -> int:
+    """This serving process's replica index (0 outside a fleet)."""
+    try:
+        return int(os.environ.get(REPLICA_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def install_replica_faults(engine: InferenceEngine,
+                           cfg: ExperimentConfig) -> None:
+    """Arm the replica-level chaos sites (resilience/faults.py) inside
+    THIS serving process: once the engine has completed
+    `replica_fault_after` responses, a scheduled `replica_crash` SIGKILLs
+    the process mid-load and a scheduled `replica_wedge` blocks the next
+    dispatch forever (a hung device call — the serve watchdog's target).
+    The site index is the replica index, so one fleet-wide fault config
+    deterministically picks which replicas get sick. No-op (and zero
+    overhead) when injection is disabled."""
+    from ..resilience.faults import build_injector
+
+    inj = build_injector(cfg.resilience.faults)
+    if inj is None:
+        return
+    idx = replica_index()
+    after = max(int(cfg.resilience.faults.replica_fault_after), 0)
+    inner = engine._forward
+
+    def forward(bucket, x):
+        with engine._stats_lock:
+            done = engine._responses
+        if done >= after:
+            if inj.hit("replica_crash", idx):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if inj.hit("replica_wedge", idx):
+                threading.Event().wait()  # never returns: wedged dispatch
+        return inner(bucket, x)
+
+    engine._forward = forward
 
 _IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".ppm", ".bmp")
 _VIDEO_EXTS = (".mp4", ".avi", ".mov", ".mkv", ".webm")
@@ -176,15 +223,38 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
     return Server((cfg.serve.host, cfg.serve.port), Handler)
 
 
+def drain_engine(engine: InferenceEngine, timeout_s: float) -> bool:
+    """Wait (bounded) until every submitted request has resolved to a
+    response or an error — the flush-in-flight half of graceful drain
+    (admission already stopped: the listener is closed). True when the
+    engine drained, False on timeout (a wedged batcher: the caller's
+    escalation — fleet SIGKILL — takes it from there)."""
+    deadline = time.monotonic() + max(float(timeout_s), 0.0)
+    while True:
+        s = engine.stats()
+        if s["serve_requests"] <= s["serve_responses"] + s["serve_errors"]:
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.05)
+
+
 def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
                model_params=None) -> int:
     """`deepof_tpu serve` (HTTP mode): engine + heartbeat + serve_forever
-    until SIGINT. Blocks; returns the exit code."""
+    until SIGINT/SIGTERM. Blocks; returns the exit code.
+
+    SIGTERM is the graceful-drain hook (the fleet supervisor's rolling
+    restart / eviction path): stop admission (shut the listener down),
+    flush in-flight requests through the engine, then exit 0. A second
+    SIGTERM — or the supervisor's SIGKILL escalation — remains the
+    hard stop for a wedged drain."""
     from ..obs.heartbeat import Heartbeat
 
     own_engine = engine is None
     if own_engine:
         engine = InferenceEngine(cfg, model_params=model_params)
+    install_replica_faults(engine, cfg)
     warm = engine.warm()
 
     # serve heartbeat: flushes are the "steps"; with NO work in flight
@@ -206,12 +276,32 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
                    period_s=cfg.obs.heartbeat_period_s,
                    watchdog_factor=cfg.obs.watchdog_factor,
                    watchdog_min_s=cfg.obs.watchdog_min_s,
-                   sample=sample)
+                   sample=sample,
+                   # a fake-executor replica stays jax-free end to end
+                   devmem=cfg.serve.fake_exec_ms is None)
     hb_ref["hb"] = hb
     engine.flush_hook = hb.beat
     httpd = build_server(cfg, engine)
     host, port = httpd.server_address[:2]
+
+    # graceful drain on SIGTERM (main thread only — tests drive
+    # build_server directly): first signal stops admission; the finally
+    # block below flushes in-flight work before exiting. Restoring the
+    # default action afterwards lets a second SIGTERM kill a wedged
+    # drain outright (the train loop's two-step convention).
+    if threading.current_thread() is threading.main_thread():
+        def _on_term(signum, frame):
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            # shutdown() blocks until serve_forever returns; hop threads
+            # so the handler itself never deadlocks the serve loop
+            threading.Thread(target=httpd.shutdown, daemon=True,
+                             name="serve-drain").start()
+
+        signal.signal(signal.SIGTERM, _on_term)
+
     print(json.dumps({"serving": f"http://{host}:{port}",
+                      "pid": os.getpid(),
+                      "replica": replica_index(),
                       "buckets": [list(b) for b in engine.buckets],
                       "max_batch": engine.max_batch,
                       "warm": warm.get("cache")}), flush=True)
@@ -220,7 +310,10 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
     except KeyboardInterrupt:
         pass
     finally:
-        httpd.server_close()
+        httpd.server_close()  # admission stopped: no new connections
+        # flush in-flight: handler threads are still parked on futures;
+        # give the batcher a bounded window to resolve them all
+        drain_engine(engine, cfg.serve.fleet.drain_timeout_s)
         if own_engine:
             engine.close()
         _log_serve_summary(cfg, engine)

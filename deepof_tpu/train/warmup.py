@@ -267,6 +267,17 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     No checkpoint needed: params enter as ShapeDtypeStructs from an
     eval_shape of model.init — warmup compiles executables for a
     *config*, ahead of any trained weights existing.
+
+    Each bucket entry reports ``persisted``: whether this bucket's
+    executable is actually IN the on-disk cache after the call — either
+    its compile wrote a new cache file, or the compile was already a
+    cache hit. A compile that persists nothing (``persisted: false``,
+    status ``skipped``) is the jax 1 s persistence floor at work:
+    sub-second forwards (e.g. flownet_s fwd-only on this host) sit AT
+    the floor and intermittently don't persist, and the floor must stay
+    at jax's default (hostmesh segfault note). The zero-recompile test
+    asserts against this report, not raw cache deltas — a skipped bucket
+    legitimately recompiles in the next process.
     """
     import jax.numpy as jnp
 
@@ -289,6 +300,14 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     # executes nothing) keeps helper compiles (zeros fills, PRNG setup)
     # from polluting the hit/miss pin
     key_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+    cache_dir = jax.config.jax_compilation_cache_dir
+
+    def _entries() -> set[str]:
+        try:
+            return set(os.listdir(cache_dir)) if cache_dir else set()
+        except OSError:
+            return set()
+
     with cache_delta() as d:
         for bucket in buckets:
             h, w = bucket
@@ -297,10 +316,24 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                 jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS), jnp.float32))
             params_sds, x_sds = serve_avals(variables_sds["params"], bucket,
                                             max_batch)
+            before_files = _entries()
+            bucket_delta = cache_delta()
             t0 = time.perf_counter()
             fwd.lower(params_sds, x_sds).compile()
+            bd = bucket_delta.stats()
+            # persisted = a new on-disk entry appeared (filesystem truth,
+            # not the counter's hope) OR the compile was already a hit
+            # (the entry predates this call). Neither => the 1 s floor
+            # swallowed it: compiled fine, persisted nothing.
+            wrote = bool(_entries() - before_files)
+            persisted = wrote or bd["hits"] >= 1
             out["buckets"].append(
                 {"bucket": [h, w],
-                 "compile_s": round(time.perf_counter() - t0, 3)})
+                 "compile_s": round(time.perf_counter() - t0, 3),
+                 "persisted": persisted,
+                 "status": ("hit" if bd["hits"] >= 1
+                            else "persisted" if wrote else "skipped")})
     out["cache"] = d.stats()
+    out["persisted_buckets"] = sum(b["persisted"] for b in out["buckets"])
+    out["skipped_buckets"] = sum(not b["persisted"] for b in out["buckets"])
     return out

@@ -22,8 +22,25 @@ from deepof_tpu.core.hostmesh import force_cpu_devices  # noqa: E402
 # which cuts repeat runs from minutes to seconds.
 force_cpu_devices(8)
 
+import socket  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Shared networking helpers for every server-shaped test (test_serve,
+# test_fleet): the canonical wait-for-listen lives next to the fleet's
+# own spawn logic — one definition, no port-collision or
+# connect-before-bind flakes.
+from deepof_tpu.serve.fleet import wait_for_listen  # noqa: E402, F401
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port that was free at bind time. Prefer binding the
+    server to port 0 and reading its bound address (race-free); use this
+    only where a port number must exist before the server does."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
 
 
 @pytest.fixture

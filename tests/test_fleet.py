@@ -1,0 +1,614 @@
+"""Serving-fleet tests (DESIGN.md "Fleet").
+
+Unit tier (in-process, no subprocess): the parent->replica config.json
+round-trip, the router's header-only image-dimension probe, and the
+routing policies — bucket affinity, load spill, saturation shedding,
+failover replay — against stub replica HTTP servers.
+
+Chaos tier (subprocess replicas, fake timed executor — jax-free, a few
+seconds of startup each): the ISSUE 6 acceptance — under sustained load
+with one injected replica SIGKILL (`replica_crash`) and one injected
+wedge (`replica_wedge`), >= 99% of requests succeed via failover, the
+sick replicas are evicted and respawned, zero requests are silently
+dropped, and `deepof_tpu tail` exits 4 surfacing the evictions; plus the
+crash-loop circuit breaker and the `serve_bench --fleet` >= 1.5x
+two-replica throughput acceptance.
+"""
+
+import base64
+import dataclasses
+import http.client
+import importlib.util
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from conftest import free_port, wait_for_listen
+
+from deepof_tpu.core.config import config_from_dict, get_config
+from deepof_tpu.serve.fleet import Fleet
+from deepof_tpu.serve.router import (Router, build_router_server,
+                                     probe_image_hw)
+
+# ----------------------------------------------------------- helpers
+
+
+def _fleet_cfg(log_dir, max_batch=4, timeout_ms=10.0, exec_ms=5.0,
+               buckets=(), image_size=(32, 64), **fleet_kw):
+    """Fast-cadence fleet config for tests: sub-second health polling,
+    short grace/backoff windows, fake timed executor replicas."""
+    fleet_defaults = dict(poll_s=0.1, stale_after_s=5.0, stall_after_s=2.0,
+                          spawn_timeout_s=90.0,
+                          term_grace_s=1.0, backoff_s=0.1, backoff_max_s=0.5,
+                          healthy_after_s=30.0, proxy_timeout_s=2.0,
+                          max_in_flight=64, drain_timeout_s=2.0)
+    fleet_defaults.update(fleet_kw)
+    cfg = get_config("flyingchairs")
+    return cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=image_size, gt_size=image_size),
+        serve=dataclasses.replace(
+            cfg.serve, max_batch=max_batch, batch_timeout_ms=timeout_ms,
+            buckets=buckets, fake_exec_ms=exec_ms, host="127.0.0.1", port=0,
+            fleet=dataclasses.replace(cfg.serve.fleet, **fleet_defaults)),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(log_dir)),
+        obs=dataclasses.replace(cfg.obs, heartbeat_period_s=0.1,
+                                watchdog_min_s=0.5))
+
+
+def _b64png(rng, hw=(30, 60)):
+    ok, buf = cv2.imencode(
+        ".png", rng.randint(1, 255, (*hw, 3), dtype=np.uint8))
+    assert ok
+    return base64.b64encode(buf.tobytes()).decode()
+
+
+def _flow_body(rng, hw=(30, 60)) -> bytes:
+    return json.dumps({"prev": _b64png(rng, hw),
+                       "next": _b64png(rng, hw)}).encode()
+
+
+def _post(port, body, path="/v1/flow", timeout=30.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body, {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(port, path="/healthz", timeout=10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _start_router(cfg, fleet):
+    router = Router(cfg, fleet)
+    httpd = build_router_server(cfg, router)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="test-router").start()
+    port = httpd.server_address[1]
+    wait_for_listen("127.0.0.1", port)
+    return router, httpd, port
+
+
+# -------------------------------------------------- config round-trip
+
+
+def test_config_json_round_trip():
+    """The parent->replica handoff: asdict -> JSON -> config_from_dict
+    reproduces the exact frozen config tree, nested tuples included."""
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        serve=dataclasses.replace(
+            cfg.serve, buckets=((64, 64), (32, 64)), fake_exec_ms=3.0,
+            fleet=dataclasses.replace(cfg.serve.fleet, replicas=3,
+                                      backoff_s=0.25)),
+        resilience=dataclasses.replace(
+            cfg.resilience,
+            faults=dataclasses.replace(cfg.resilience.faults, enabled=True,
+                                       replica_crash_at=(0, 2),
+                                       decode_at=(1, 5))))
+    restored = config_from_dict(json.loads(json.dumps(
+        dataclasses.asdict(cfg))))
+    assert restored == cfg
+    assert restored.serve.buckets == ((64, 64), (32, 64))
+    assert restored.resilience.faults.replica_crash_at == (0, 2)
+    with pytest.raises(ValueError):
+        config_from_dict({"not_a_field": 1})
+    with pytest.raises(ValueError, match="serve"):
+        # typos at ANY level must not silently become defaults
+        config_from_dict({"serve": {"fake_exec_sm": 5.0}})
+
+
+# ------------------------------------------------------- header probe
+
+
+def test_probe_image_hw_headers_only(rng):
+    img = rng.randint(0, 255, (48, 96, 3), dtype=np.uint8)
+    for ext in (".png", ".jpg", ".bmp"):
+        ok, buf = cv2.imencode(ext, img)
+        assert ok
+        assert probe_image_hw(buf.tobytes()) == (48, 96), ext
+    assert probe_image_hw(b"not an image") is None
+    assert probe_image_hw(b"") is None
+    # a truncated PNG header (first KB) still probes — the router only
+    # ever sees a prefix of the payload
+    ok, buf = cv2.imencode(".png", img)
+    assert probe_image_hw(buf.tobytes()[:64]) == (48, 96)
+
+
+# ------------------------------------------- router policy (stub fleet)
+
+
+class _StubFleet:
+    """Duck-typed Fleet for router unit tests: fixed (idx, port) slots,
+    None = not ready."""
+
+    def __init__(self, ports, host="127.0.0.1"):
+        self.host = host
+        self.ports = list(ports)
+        self.size = len(self.ports)
+        self.failures = []
+
+    def ready_replicas(self):
+        return [SimpleNamespace(idx=i, port=p)
+                for i, p in enumerate(self.ports) if p is not None]
+
+    def note_failure(self, idx):
+        self.failures.append(idx)
+
+    def stats(self):
+        return {"fleet_replicas": self.size,
+                "fleet_ready": len(self.ready_replicas())}
+
+    def describe(self):
+        return []
+
+
+def _stub_replica(delay_s=0.0):
+    """Minimal replica-shaped HTTP server: POST -> optional sleep ->
+    200 with its own tag (so tests see who served)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if delay_s:
+                time.sleep(delay_s)
+            body = json.dumps({"served_by": self.server.server_address[1]})
+            body = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_router_affinity_maps_buckets_to_replicas(rng, tmp_path):
+    """Bucket i of the ladder routes to replica i % N while replicas are
+    idle — each replica's AOT executables stay hot for its slice."""
+    cfg = _fleet_cfg(tmp_path, buckets=((32, 64), (64, 64)))
+    s0, s1 = _stub_replica(), _stub_replica()
+    try:
+        fleet = _StubFleet([s0.server_address[1], s1.server_address[1]])
+        router = Router(cfg, fleet)
+        for _ in range(3):  # bucket (32,64) -> ladder[0] -> replica 0
+            status, payload, _ = router.handle_flow(
+                "/v1/flow", _flow_body(rng, (30, 60)), "application/json")
+            assert status == 200
+            assert json.loads(payload)["served_by"] == s0.server_address[1]
+        for _ in range(3):  # bucket (64,64) -> ladder[1] -> replica 1
+            status, payload, _ = router.handle_flow(
+                "/v1/flow", _flow_body(rng, (60, 60)), "application/json")
+            assert status == 200
+            assert json.loads(payload)["served_by"] == s1.server_address[1]
+        stats = router.stats()
+        assert stats["fleet_routed"] == {"replica-0": 3, "replica-1": 3}
+        assert stats["fleet_failovers"] == 0
+    finally:
+        for s in (s0, s1):
+            s.shutdown()
+            s.server_close()
+
+
+def test_router_failover_replays_on_healthy_sibling(rng, tmp_path):
+    """A dead replica (connection refused) is retried on the next
+    healthy one; the supervisor is poked; exhausting every candidate
+    yields a structured 502, never silence."""
+    cfg = _fleet_cfg(tmp_path)
+    live = _stub_replica()
+    try:
+        dead_port = free_port()
+        fleet = _StubFleet([dead_port, live.server_address[1]])
+        router = Router(cfg, fleet)
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", _flow_body(rng), "application/json")
+        assert status == 200
+        assert json.loads(payload)["served_by"] == live.server_address[1]
+        stats = router.stats()
+        assert stats["fleet_retries"] == 1
+        assert stats["fleet_failovers"] == 1
+        assert fleet.failures == [0]
+
+        # every replica dead -> structured 502 after bounded retries
+        fleet2 = _StubFleet([free_port(), free_port()])
+        router2 = Router(cfg, fleet2)
+        status, payload, _ = router2.handle_flow(
+            "/v1/flow", _flow_body(rng), "application/json")
+        assert status == 502
+        err = json.loads(payload)
+        assert err["error"] == "replica_failed"
+        assert err["attempts"] == 2
+
+        # no ready replica at all -> structured 503 unavailable
+        router3 = Router(cfg, _StubFleet([None, None]))
+        status, payload, _ = router3.handle_flow(
+            "/v1/flow", _flow_body(rng), "application/json")
+        assert status == 503
+        assert json.loads(payload)["error"] == "unavailable"
+    finally:
+        live.shutdown()
+        live.server_close()
+
+
+def test_router_sheds_structured_503_when_saturated(rng, tmp_path):
+    """Backpressure at the front: when every healthy replica is at
+    fleet.max_in_flight the router answers a structured 503
+    ('overloaded') instead of queuing unboundedly; spill past the
+    affinity replica happens first."""
+    cfg = _fleet_cfg(tmp_path, max_in_flight=1, spill_in_flight=1)
+    slow0, slow1 = _stub_replica(delay_s=0.8), _stub_replica(delay_s=0.8)
+    try:
+        fleet = _StubFleet([slow0.server_address[1],
+                            slow1.server_address[1]])
+        router = Router(cfg, fleet)
+        body = _flow_body(rng)
+        results = [None, None, None]
+
+        def call(i):
+            results[i] = router.handle_flow("/v1/flow", body,
+                                            "application/json")
+
+        threads = []
+        for i in range(3):  # 2 saturate both replicas; the 3rd sheds
+            t = threading.Thread(target=call, args=(i,))
+            t.start()
+            threads.append(t)
+            time.sleep(0.2)
+        for t in threads:
+            t.join(timeout=30)
+        statuses = sorted(r[0] for r in results)
+        assert statuses == [200, 200, 503]
+        shed = next(r for r in results if r[0] == 503)
+        assert json.loads(shed[1])["error"] == "overloaded"
+        stats = router.stats()
+        assert stats["fleet_shed"] == 1
+        # the two successes spilled across BOTH replicas
+        assert set(stats["fleet_routed"]) == {"replica-0", "replica-1"}
+    finally:
+        for s in (slow0, slow1):
+            s.shutdown()
+            s.server_close()
+
+
+# --------------------------------------------------- tail integration
+
+
+def test_tail_exits_4_surfacing_fleet_evictions(tmp_path, capsys):
+    """`tail` must fail scripted health checks when the fleet block
+    shows self-healing activity (evictions) or a broken replica — rc 4,
+    distinct from the wedged rc 3."""
+    from deepof_tpu.cli import main
+
+    (tmp_path / "metrics.jsonl").write_text(json.dumps(
+        {"kind": "serve", "step": 0, "time": time.time(),
+         "fleet_replicas": 3, "fleet_ready": 3, "fleet_evictions": 0,
+         "fleet_broken": 0, "fleet_requests": 10}) + "\n")
+    (tmp_path / "heartbeat.json").write_text(json.dumps(
+        {"time": time.time(), "step": 10, "wedged": False,
+         "fleet_replicas": 3, "fleet_ready": 3, "fleet_evictions": 0,
+         "fleet_broken": 0, "fleet_requests": 10, "fleet_failovers": 0}))
+    assert main(["tail", "--log-dir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fleet"]["ready"] == 3  # the fleet block surfaces
+
+    (tmp_path / "heartbeat.json").write_text(json.dumps(
+        {"time": time.time(), "step": 30, "wedged": False,
+         "fleet_replicas": 3, "fleet_ready": 3, "fleet_evictions": 2,
+         "fleet_respawns": 2, "fleet_broken": 0, "fleet_failovers": 5}))
+    assert main(["tail", "--log-dir", str(tmp_path)]) == 4
+    out = json.loads(capsys.readouterr().out)
+    assert out["fleet"]["evictions"] == 2
+    assert out["fleet"]["failovers"] == 5
+
+    # a broken replica alone (no evictions counted) also exits 4
+    (tmp_path / "heartbeat.json").write_text(json.dumps(
+        {"time": time.time(), "step": 30, "wedged": False,
+         "fleet_evictions": 0, "fleet_broken": 1}))
+    assert main(["tail", "--log-dir", str(tmp_path)]) == 4
+    capsys.readouterr()
+
+
+# ------------------------------------------------ chaos (subprocess)
+
+
+def _drive_load(port, bodies, total, clients, outcomes, stop=None):
+    """Closed-loop client pool against the router; every request's
+    outcome (status, payload) is recorded — the zero-silent-drops
+    ledger."""
+    import itertools
+
+    counter = itertools.count()
+    lock = threading.Lock()
+
+    def worker():
+        i = 0
+        while True:
+            n = next(counter)
+            if n >= total or (stop is not None and stop.is_set()):
+                return
+            body = bodies[n % len(bodies)]
+            try:
+                status, payload = _post(port, body, timeout=30.0)
+            except Exception as e:  # noqa: BLE001 - a drop would be a bug
+                status, payload = -1, str(e).encode()
+            with lock:
+                outcomes.append((status, payload))
+            i += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return outcomes
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_crash_and_wedge_heal_via_failover(rng, tmp_path):
+    """ISSUE 6 acceptance (fast tier, subprocess): 3 replicas under
+    sustained load with a seeded SIGKILL on replica 0 and a seeded
+    dispatch wedge on replica 1. The router replays failed requests on
+    healthy siblings (>= 99% success), the supervisor evicts the sick
+    replicas (the wedge via the serve heartbeat watchdog) and respawns
+    them, every request resolves to a response or a structured error,
+    and `tail` exits 4 surfacing the evictions."""
+    from deepof_tpu.cli import main as cli_main
+    from deepof_tpu.obs.heartbeat import Heartbeat
+
+    fleet_dir = tmp_path / "fleet"
+    cfg = _fleet_cfg(fleet_dir, max_batch=4, timeout_ms=5.0, exec_ms=5.0)
+    cfg = cfg.replace(resilience=dataclasses.replace(
+        cfg.resilience,
+        faults=dataclasses.replace(cfg.resilience.faults, enabled=True,
+                                   replica_crash_at=(0,),
+                                   replica_wedge_at=(1,),
+                                   replica_fault_after=40)))
+    total, clients = 240, 6
+    bodies = [_flow_body(rng) for _ in range(4)]
+    outcomes: list = []
+    with Fleet(cfg, 3) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=3, timeout_s=120)
+        router, httpd, port = _start_router(cfg, fleet)
+        # watchdog floored out of the way: this heartbeat only carries
+        # the fleet_* block for tail (run_fleet's touch()es when idle;
+        # here load simply stops, which must not read as a wedge)
+        hb = Heartbeat(str(fleet_dir / "heartbeat.json"), period_s=0.1,
+                       watchdog_min_s=3600.0,
+                       sample=lambda: {**fleet.stats(), **router.stats()},
+                       devmem=False)
+        router.beat_hook = hb.beat
+        try:
+            _drive_load(port, bodies, total, clients, outcomes)
+            # the wedged replica's eviction may trail the load (watchdog
+            # window + poll): wait for the supervisor to finish healing
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                s = fleet.stats()
+                if (s["fleet_crashes"] >= 1
+                        and s["fleet_wedge_evictions"] >= 1
+                        and s["fleet_respawns"] >= 1):
+                    break
+                time.sleep(0.2)
+            stats = fleet.stats()
+            time.sleep(0.3)  # one heartbeat period: the block lands
+        finally:
+            hb.close()
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+
+    # zero silent drops: every submitted request resolved
+    assert len(outcomes) == total
+    ok = sum(1 for s, _ in outcomes if s == 200)
+    failures = [(s, p[:200]) for s, p in outcomes if s != 200]
+    # >= 99% success via failover (the injected faults kill in-flight
+    # requests on 2 of 3 replicas; the router replays them)
+    assert ok >= int(0.99 * total), (ok, total, failures[:5])
+    for status, payload in failures:
+        assert status > 0, f"transport-level silent failure: {payload}"
+        assert b"error" in payload, (status, payload)
+
+    # the supervisor observed and healed both failure modes
+    assert stats["fleet_crashes"] >= 1, stats
+    assert stats["fleet_wedge_evictions"] >= 1, stats
+    assert stats["fleet_evictions"] >= 2, stats
+    assert stats["fleet_respawns"] >= 1, stats
+    assert stats["fleet_broken"] == 0, stats
+
+    # the router actually failed over under the faults
+    rstats = router.stats()
+    assert rstats["fleet_failovers"] >= 1, rstats
+
+    # the fleet heartbeat surfaces it and tail exits 4
+    rc = cli_main(["tail", "--log-dir", str(fleet_dir)])
+    assert rc == 4
+
+
+@pytest.mark.chaos
+def test_fleet_evicts_wedge_before_replica_watchdog_arms(rng, tmp_path):
+    """A dispatch that hangs on the replica's FIRST flush wedges before
+    its own watchdog can arm (3 completed flushes needed), and its
+    heartbeat keeps rewriting fresh with wedged:false — the supervisor's
+    stall detector (in-flight > 0 with no completion for
+    fleet.stall_after_s) must evict it anyway, instead of leaving a
+    permanent proxy-timeout tarpit on its affinity bucket."""
+    fleet_dir = tmp_path / "fleet"
+    cfg = _fleet_cfg(fleet_dir, stall_after_s=1.0, proxy_timeout_s=1.0)
+    cfg = cfg.replace(resilience=dataclasses.replace(
+        cfg.resilience,
+        faults=dataclasses.replace(cfg.resilience.faults, enabled=True,
+                                   replica_wedge_at=(0,),
+                                   replica_fault_after=0)))
+    outcomes: list = []
+    with Fleet(cfg, 2) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=2, timeout_s=120)
+        router, httpd, port = _start_router(cfg, fleet)
+        try:
+            bodies = [_flow_body(rng)]
+            _drive_load(port, bodies, 24, 3, outcomes)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if fleet.stats()["fleet_wedge_evictions"] >= 1:
+                    break
+                time.sleep(0.1)
+            stats = fleet.stats()
+        finally:
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+    # every request resolved, via failover to the healthy replica
+    assert len(outcomes) == 24
+    assert all(s == 200 for s, _ in outcomes), \
+        [o for o in outcomes if o[0] != 200][:5]
+    # evicted WITHOUT the replica's own watchdog ever flagging wedged
+    assert stats["fleet_wedge_evictions"] >= 1, stats
+    assert stats["fleet_evictions"] >= 1, stats
+
+
+@pytest.mark.chaos
+def test_fleet_circuit_breaker_stops_crash_loop(rng, tmp_path):
+    """A replica that dies on its first dispatch every incarnation is
+    respawned with backoff, then circuit-broken after
+    crash_loop_threshold consecutive fast failures — instead of
+    respawning forever — while the healthy replica keeps serving every
+    request via failover."""
+    fleet_dir = tmp_path / "fleet"
+    cfg = _fleet_cfg(fleet_dir, crash_loop_threshold=2, backoff_s=0.05,
+                     backoff_max_s=0.2)
+    cfg = cfg.replace(resilience=dataclasses.replace(
+        cfg.resilience,
+        faults=dataclasses.replace(cfg.resilience.faults, enabled=True,
+                                   replica_crash_at=(0,),
+                                   replica_fault_after=0)))
+    outcomes: list = []
+    stop = threading.Event()
+    with Fleet(cfg, 2) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=2, timeout_s=120)
+        router, httpd, port = _start_router(cfg, fleet)
+        bodies = [_flow_body(rng)]
+        driver = threading.Thread(
+            target=_drive_load,
+            args=(port, bodies, 10_000, 2, outcomes, stop), daemon=True)
+        driver.start()
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if fleet.stats()["fleet_broken"] >= 1:
+                    break
+                time.sleep(0.1)
+            stop.set()
+            driver.join(timeout=60)
+            stats = fleet.stats()
+            # breaker open: replica 0 stays down, no more respawns
+            assert stats["fleet_broken"] == 1, stats
+            assert stats["fleet_states"]["replica-0"] == "broken", stats
+            assert stats["fleet_crashes"] >= 2, stats
+            assert stats["fleet_respawns"] >= 1, stats
+            respawns_at_break = stats["fleet_respawns"]
+            # service never went down: every request resolved, and the
+            # healthy replica answers after the breaker opened
+            assert outcomes and all(s == 200 for s, _ in outcomes), \
+                [o for o in outcomes if o[0] != 200][:5]
+            status, _ = _post(port, bodies[0])
+            assert status == 200
+            time.sleep(1.0)
+            assert fleet.stats()["fleet_respawns"] == respawns_at_break
+        finally:
+            stop.set()
+            httpd.shutdown()
+            httpd.server_close()
+    # graceful drain: the healthy replica exited cleanly on SIGTERM
+    assert fleet._replicas[1].last_exit == 0
+
+
+# --------------------------------------------------- serve_bench fleet
+
+
+def _load_serve_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_bench.py")
+    spec = importlib.util.spec_from_file_location("serve_bench_fleet", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+def test_serve_bench_fleet_2x_replicas_beats_single(tmp_path):
+    """ISSUE 6 acceptance: `serve_bench --fleet` with 2 healthy replicas
+    sustains >= 1.5x a single replica's throughput through the full
+    HTTP + router path. max_batch=1 + a per-dispatch sleep makes the
+    fake executor latency-bound, so the win is genuine replica
+    parallelism; the ratio gets one bounded retry against scheduler
+    spikes on this 1-core host (schema asserted strictly every time)."""
+    sb = _load_serve_bench()
+    for attempt in range(2):
+        # exec_ms dominates the per-request HTTP/router CPU cost on this
+        # 1-core host, so the measured ratio stays near the ideal 2x
+        res = sb.fleet_bench(replicas=2, requests=32, clients=6,
+                             max_batch=1, timeout_ms=2.0, exec_ms=40.0,
+                             log_dir=str(tmp_path / f"bench{attempt}"))
+        for key in sb.FLEET_REQUIRED_KEYS:
+            assert key in res, f"fleet_bench result missing {key!r}"
+        json.dumps(res)  # JSON-line contract
+        assert res["mode"] == "fleet" and res["replicas"] == 2
+        assert res["errors"] == 0 and res["single_errors"] == 0
+        assert res["shed"] == 0
+        # both replicas actually served (the spill policy spreads a
+        # saturated single-bucket load)
+        assert len(res["routed"]) == 2, res["routed"]
+        if res["speedup_vs_single"] >= 1.5:
+            break
+    assert res["speedup_vs_single"] >= 1.5, res

