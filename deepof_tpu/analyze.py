@@ -112,7 +112,9 @@ def _resilience_counters(rec: dict) -> dict:
 def _serve_counters(rec: dict) -> dict:
     """`serve_*` counters from one record or heartbeat sample (the
     serving subsystem's block: requests/responses/errors, batch
-    occupancy, latency percentiles, queue depths)."""
+    occupancy, latency percentiles, queue depths, and the per-precision
+    `requests_by_tier`/`responses_by_tier` maps — a tier nobody asks
+    for shows up as a zero here, not as silence)."""
     return {k[len("serve_"):]: v for k, v in rec.items()
             if k.startswith("serve_") and v is not None}
 

@@ -141,6 +141,14 @@ def main(argv=None) -> int:
                         help="image-path pairs, colon-separated")
     p_pred.add_argument("--out", required=True, help="output directory")
     p_pred.add_argument("--no-png", action="store_true")
+    p_pred.add_argument("--precision", default=None,
+                        choices=("f32", "bf16", "int8"),
+                        help="serving precision tier (must be in "
+                             "serve.precisions; default: the config's "
+                             "first tier). bf16 halves and int8 quarters "
+                             "the weight bytes each dispatch moves "
+                             "(weight-only, per-output-channel scales; "
+                             "DESIGN.md \"Precision tiers\")")
 
     p_cfg = sub.add_parser("config", help="print the resolved config")
     _add_common(p_cfg)
@@ -152,10 +160,11 @@ def main(argv=None) -> int:
     p_warm.add_argument("--no-eval", action="store_true",
                         help="skip the eval executable")
     p_warm.add_argument("--serve", action="store_true",
-                        help="also AOT-compile the serve bucket ladder "
-                             "(serve.buckets x serve.max_batch inference "
-                             "executables) so a cold engine's first "
-                             "requests load instead of compiling")
+                        help="also AOT-compile the serve ladder "
+                             "(serve.buckets x serve.precisions "
+                             "inference executables at serve.max_batch) "
+                             "so a cold engine's first requests load "
+                             "instead of compiling")
     p_warm.add_argument("--serve-only", action="store_true",
                         help="compile only the serve ladder (skip "
                              "train/eval)")
@@ -385,7 +394,8 @@ def main(argv=None) -> int:
             prev, nxt = item.split(":", 1)
             pairs.append((prev, nxt))
         written = predict_pairs(cfg, pairs, args.out,
-                                write_png=not args.no_png)
+                                write_png=not args.no_png,
+                                precision=args.precision)
         print(json.dumps({"written": written}))
         return 0
 

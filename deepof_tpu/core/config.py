@@ -143,8 +143,12 @@ class DataConfig:
     # deterministic per-batch seeding — the delivered stream is
     # bit-identical for any worker count. 0 = assemble inline on the
     # prefetch thread (the legacy single-thread path, zero overhead).
-    # cv2 and the native C++ IO release the GIL, so decode parallelism
-    # is real; size to the host cores left over after the runtime.
+    # -1 = auto (data/pipeline.py resolve_num_workers): 0 on hosts with
+    # <= 2 cores — BENCH_r06 measured workers=4 at 49.5 vs workers=0 at
+    # 85.3 batches/s on a small host (thread contention, nothing to
+    # overlap) — else min(4, cores - 2). cv2 and the native C++ IO
+    # release the GIL, so decode parallelism is real; size to the host
+    # cores left over after the runtime.
     num_workers: int = 0
     # Reorder-buffer bound: how many batches workers may run ahead of
     # delivery (caps buffered-batch memory when one slow batch holds
@@ -362,6 +366,16 @@ class ServeConfig:
     # so the set of compiled executables is fixed and warmable
     # (`warmup --serve`). () = one bucket at data.image_size.
     buckets: tuple[tuple[int, int], ...] = ()
+    # Mixed-precision serving tiers (serve/quant.py): which weight
+    # precisions this endpoint offers. Each (bucket, tier) pair owns one
+    # AOT executable — "f32" (checkpoint-native), "bf16" (weights cast,
+    # half the weight bytes per dispatch), "int8" (weight-only
+    # per-output-channel quantized conv kernels, dequantized inside the
+    # forward; biases/norm params stay f32). A request's `precision`
+    # field (HTTP body / predict_pairs arg) picks its tier; the FIRST
+    # entry here is the default when a request names none. `warmup
+    # --serve` pre-compiles the full bucket x tier ladder.
+    precisions: tuple[str, ...] = ("f32",)
     # Request-queue bound: submit() blocks when this many requests are
     # pending (backpressure instead of unbounded host memory). 0 = unbounded.
     queue_depth: int = 256

@@ -92,7 +92,8 @@ def output_stem(src_path: str, idx: int, many: bool) -> str:
 
 def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
                   out_dir: str, mean=None, write_png: bool = True,
-                  model_params=None) -> list[str]:
+                  model_params=None, precision: str | None = None
+                  ) -> list[str]:
     """Predict flow for (prev, next) image-path pairs; returns written paths.
 
     The net runs at the request's shape bucket (default ladder: one
@@ -111,6 +112,10 @@ def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
 
     model_params: optional (model, params) overriding the checkpoint
     restore (tests; callers that already restored).
+    precision: serving tier for every pair ("f32" | "bf16" | "int8";
+    must be in cfg.serve.precisions — the engine owns one quantized
+    params tree and one AOT executable per (bucket, tier)). None = the
+    config's first tier.
     """
     from collections import deque
 
@@ -136,7 +141,8 @@ def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
                 write_png=write_png))
 
         for idx, (src, tgt) in enumerate(pairs):
-            buf.append((idx, src, eng.submit(src, tgt)))
+            buf.append((idx, src, eng.submit(src, tgt,
+                                             precision=precision)))
             if len(buf) >= window:
                 drain_one()
         while buf:

@@ -20,9 +20,15 @@ Design decisions that matter:
     (pinned in tests/test_serve.py).
   - Executables are AOT-compiled (`jit(...).lower(avals).compile()`)
     through the PR 1 persistent compile cache; `warmup --serve` runs the
-    identical lowering per bucket ahead of time, so a cold engine's
-    first requests LOAD executables instead of compiling (compile-cache
-    counters pinned in tests).
+    identical lowering per (bucket, tier) ahead of time, so a cold
+    engine's first requests LOAD executables instead of compiling
+    (compile-cache counters pinned in tests).
+  - Precision is a request axis (serve/quant.py): each configured tier
+    (f32 / bf16 weight-cast / int8 weight-only per-channel quantized)
+    owns its own params tree and its own executable per bucket, and the
+    batcher groups by (bucket, tier) — a request's `precision` field
+    picks its operating point on the speed/accuracy frontier without
+    touching its batchmates.
   - Decode/preprocess runs on the SUBMITTING thread (cv2 releases the
     GIL): a corrupt or undecodable input fails that one future with a
     structured ServeError before it ever reaches the batcher — a
@@ -53,6 +59,7 @@ import numpy as np
 from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
 from .buckets import flow_to_native, pick_bucket, prepare_pair, resolve_buckets
+from .quant import dequantize_params, quantize_params, resolve_precisions
 
 _STOP = object()
 
@@ -83,15 +90,23 @@ class ServeError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "bucket", "native_hw", "future", "t_enq", "rid")
+    __slots__ = ("x", "bucket", "tier", "native_hw", "future", "t_enq",
+                 "rid")
 
-    def __init__(self, x, bucket, native_hw, future, t_enq, rid):
+    def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid):
         self.x = x
         self.bucket = bucket
+        self.tier = tier
         self.native_hw = native_hw
         self.future = future
         self.t_enq = t_enq
         self.rid = rid
+
+    @property
+    def key(self) -> tuple[tuple[int, int], str]:
+        """The dispatch-group identity: requests batch together iff they
+        share (bucket, tier) — one executable per key."""
+        return (self.bucket, self.tier)
 
 
 def build_serve_model(cfg: ExperimentConfig):
@@ -110,10 +125,14 @@ def build_serve_model(cfg: ExperimentConfig):
 def make_raw_forward(model) -> Callable:
     """(params, pairs[B,H,W,6]) -> finest scaled flow [B,h,w,2]. Defined
     once so the engine's runtime lowering and warmup's AOT lowering
-    produce the same HLO (same persistent-cache key)."""
+    produce the same HLO (same persistent-cache key). `params` may be a
+    quantized tier tree (serve/quant.py): int8 kernels dequantize HERE,
+    inside the trace, so the executable's params input stays int8 while
+    activations run f32 — on an f32/bf16 tree the dequantize pass
+    inserts nothing and the HLO is unchanged."""
 
     def fwd(params, x):
-        flows = model.apply({"params": params}, x)
+        flows = model.apply({"params": dequantize_params(params)}, x)
         return flows[0] * model.flow_scales[0]
 
     return fwd
@@ -167,7 +186,10 @@ class InferenceEngine:
     mean: optional BGR dataset mean override (DATASET_MEANS default).
     forward_fn: optional (bucket, x[max_batch,H,W,6]) -> [max_batch,h,w,2]
         executor replacing the jitted model entirely — the deterministic
-        fake timed executor the batcher tests and serve_bench use.
+        fake timed executor the batcher tests and serve_bench use. A
+        custom executor is precision-blind (it has no weights to
+        quantize): every tier routes/batches separately but executes the
+        same function.
     """
 
     def __init__(self, cfg: ExperimentConfig, model_params=None, mean=None,
@@ -176,6 +198,11 @@ class InferenceEngine:
         self.max_batch = max(int(cfg.serve.max_batch), 1)
         self.timeout_s = max(float(cfg.serve.batch_timeout_ms), 0.0) / 1e3
         self.buckets = resolve_buckets(cfg)
+        # precision tiers: one executable per (bucket, tier); the
+        # config's first entry is the default a request gets when it
+        # names none (serve/quant.py owns the transforms)
+        self.tiers = resolve_precisions(cfg)
+        self.default_tier = self.tiers[0]
         if mean is None:
             from ..data.datasets import DATASET_MEANS
 
@@ -190,7 +217,10 @@ class InferenceEngine:
             forward_fn = make_fake_forward(float(cfg.serve.fake_exec_ms))
         self._forward_custom = forward_fn is not None
         if self._forward_custom:
-            self._forward = forward_fn
+            # internal convention: _forward(key, x) with key =
+            # (bucket, tier); custom executors keep their documented
+            # (bucket, x) signature — they are precision-blind
+            self._forward = lambda key, x, _fn=forward_fn: _fn(key[0], x)
             self._model = self._params = None
         else:
             if model_params is not None:
@@ -214,10 +244,23 @@ class InferenceEngine:
             # would mismatch that compiled input spec, so serving
             # canonicalizes them onto one device; scale-out is N engine
             # processes, not in-engine batch sharding.
-            self._params = jax.device_put(self._params, jax.devices()[0])
+            dev = jax.devices()[0]
+            self._params = jax.device_put(self._params, dev)
+            # one quantized params tree per tier, staged once (int8 is a
+            # quarter, bf16 half the f32 bytes); the tier trees' avals
+            # differ, so each (bucket, tier) lowers to its own cache key
+            self._params_by_tier = {
+                tier: jax.device_put(quantize_params(self._params, tier),
+                                     dev)
+                for tier in self.tiers}
+            if "f32" not in self.tiers:
+                # nothing reads the f32 tree once the tier trees exist;
+                # keeping it would hold 1-2x the configured ladder's
+                # weight bytes on the device for the engine's lifetime
+                self._params = None
             self._jit = jax.jit(make_raw_forward(self._model))
             self._forward = self._model_forward
-        self._compiled: dict[tuple[int, int], object] = {}
+        self._compiled: dict[tuple[tuple[int, int], str], object] = {}
         self._compile_lock = threading.Lock()
 
         depth = max(int(cfg.serve.queue_depth), 0)
@@ -237,6 +280,11 @@ class InferenceEngine:
         self._batches = 0
         self._dispatch_failures = 0
         self._bucket_splits = 0
+        self._tier_splits = 0
+        # per-tier request/response counts (analyze/tail surface these
+        # so a tier nobody asks for is visible as such)
+        self._requests_by_tier = {t: 0 for t in self.tiers}
+        self._responses_by_tier = {t: 0 for t in self.tiers}
         self._timeout_flushes = 0
         self._occupancy_sum = 0
         self._last_occupancy = 0
@@ -264,27 +312,48 @@ class InferenceEngine:
 
         return _imread_bgr(str(img))
 
-    def submit(self, prev, nxt) -> Future:
+    def _resolve_tier(self, precision, rid) -> str:
+        """A request's tier: its explicit `precision` or the config's
+        default; a tier this endpoint does not serve is a structured
+        per-request error (no executable exists for it — admitting it
+        would compile on the hot path)."""
+        if precision is None:
+            return self.default_tier
+        tier = str(precision)
+        if tier not in self.tiers:
+            raise ServeError(
+                "bad_request",
+                f"precision {tier!r} not served; this endpoint offers "
+                f"{list(self.tiers)}", rid)
+        return tier
+
+    def submit(self, prev, nxt, precision: str | None = None) -> Future:
         """Enqueue one (prev, next) pair — paths or decoded BGR arrays.
 
+        precision: serving tier ("f32" | "bf16" | "int8"); must be in
+        cfg.serve.precisions; None = the config's first (default) tier.
+
         Returns a Future resolving to {"flow": (H_native, W_native, 2)
-        float32 in native pixel units, "bucket", "native_hw",
-        "latency_s", "request_id"}; failures raise ServeError from
-        .result(). Decode/preprocess errors fail HERE (this request
-        only) — they never enter the batcher.
+        float32 in native pixel units, "bucket", "precision",
+        "native_hw", "latency_s", "request_id"}; failures raise
+        ServeError from .result(). Decode/preprocess errors fail HERE
+        (this request only) — they never enter the batcher.
         """
         rid = next(self._rid)
         fut: Future = Future()
         with self._stats_lock:
             self._requests += 1
         try:
+            tier = self._resolve_tier(precision, rid)
             with obs_trace.span("serve_enqueue", request_id=rid):
                 src = self._decode(prev)
                 tgt = self._decode(nxt)
                 native_hw = (int(src.shape[0]), int(src.shape[1]))
                 bucket = pick_bucket(native_hw, self.buckets)
                 x = prepare_pair(src, tgt, bucket, self.mean)
-            self._enqueue(_Request(x, bucket, native_hw, fut,
+            with self._stats_lock:
+                self._requests_by_tier[tier] += 1
+            self._enqueue(_Request(x, bucket, tier, native_hw, fut,
                                    time.monotonic(), rid))
         except ServeError as e:
             e.request_id = e.request_id or rid
@@ -295,7 +364,8 @@ class InferenceEngine:
         return fut
 
     def submit_prepared(self, x: np.ndarray, bucket: tuple[int, int],
-                        native_hw: tuple[int, int]) -> Future:
+                        native_hw: tuple[int, int],
+                        precision: str | None = None) -> Future:
         """Enqueue an already-preprocessed row (offline mode: the
         data/pipeline.py worker pool runs prepare_pair concurrently and
         feeds rows here in order)."""
@@ -304,8 +374,11 @@ class InferenceEngine:
         with self._stats_lock:
             self._requests += 1
         try:
+            tier = self._resolve_tier(precision, rid)
+            with self._stats_lock:
+                self._requests_by_tier[tier] += 1
             self._enqueue(_Request(np.asarray(x, np.float32), tuple(bucket),
-                                   tuple(native_hw), fut,
+                                   tier, tuple(native_hw), fut,
                                    time.monotonic(), rid))
         except ServeError as e:
             e.request_id = e.request_id or rid
@@ -369,10 +442,13 @@ class InferenceEngine:
                     if nxt is _STOP:
                         stop = True
                         break
-                    if nxt.bucket != batch[0].bucket:
+                    if nxt.key != batch[0].key:
                         pending = nxt  # flush now; it opens the next batch
                         with self._stats_lock:
-                            self._bucket_splits += 1
+                            if nxt.bucket != batch[0].bucket:
+                                self._bucket_splits += 1
+                            else:  # same shape, different precision
+                                self._tier_splits += 1
                         break
                     batch.append(nxt)
             if timed_out and len(batch) < self.max_batch:
@@ -392,16 +468,16 @@ class InferenceEngine:
                     req.rid))
 
     def _flush(self, batch: list[_Request]) -> None:
-        bucket = batch[0].bucket
+        bucket, tier = batch[0].key
         n = len(batch)
-        tag = f"{bucket[0]}x{bucket[1]}"
+        tag = f"{bucket[0]}x{bucket[1]}/{tier}"
         with obs_trace.span("serve_dispatch", occupancy=n, bucket=tag):
             x = np.zeros((self.max_batch, bucket[0], bucket[1],
                           batch[0].x.shape[-1]), np.float32)
             for i, r in enumerate(batch):
                 x[i] = r.x
             try:
-                out = np.asarray(self._forward(bucket, x))
+                out = np.asarray(self._forward(batch[0].key, x))
             except Exception as e:  # noqa: BLE001 - the flush fails, not the engine
                 with self._stats_lock:
                     self._dispatch_failures += 1
@@ -422,6 +498,7 @@ class InferenceEngine:
                 done = time.monotonic()
                 with self._stats_lock:
                     self._responses += 1
+                    self._responses_by_tier[r.tier] += 1
                     self._latency_s.append(done - r.t_enq)
                     sec = int(done)
                     self._done_per_s[sec] = self._done_per_s.get(sec, 0) + 1
@@ -430,6 +507,7 @@ class InferenceEngine:
                                     if s < sec - _RATE_WINDOW_S - 1]:
                             del self._done_per_s[old]
                 r.future.set_result({"flow": flow, "bucket": bucket,
+                                     "precision": tier,
                                      "native_hw": r.native_hw,
                                      "latency_s": done - r.t_enq,
                                      "request_id": r.rid})
@@ -446,27 +524,29 @@ class InferenceEngine:
                 pass
 
     # ---------------------------------------------------------- forward
-    def _model_forward(self, bucket: tuple[int, int], x: np.ndarray):
-        return self._executable(bucket)(self._params, x)
+    def _model_forward(self, key: tuple[tuple[int, int], str],
+                       x: np.ndarray):
+        return self._executable(key)(self._params_by_tier[key[1]], x)
 
-    def _executable(self, bucket: tuple[int, int]):
-        """The bucket's AOT-compiled forward, compiled (or loaded from
-        the persistent cache — the `warmup --serve` contract) on first
-        use."""
+    def _executable(self, key: tuple[tuple[int, int], str]):
+        """The (bucket, tier) pair's AOT-compiled forward, compiled (or
+        loaded from the persistent cache — the `warmup --serve`
+        contract) on first use."""
         with self._compile_lock:
-            c = self._compiled.get(bucket)
+            c = self._compiled.get(key)
             if c is None:
-                params_sds, x_sds = serve_avals(self._params, bucket,
-                                                self.max_batch)
+                bucket, tier = key
+                params_sds, x_sds = serve_avals(self._params_by_tier[tier],
+                                                bucket, self.max_batch)
                 c = self._jit.lower(params_sds, x_sds).compile()
-                self._compiled[bucket] = c
+                self._compiled[key] = c
         return c
 
     def warm(self) -> dict:
-        """AOT-compile every configured bucket now (server startup /
-        offline-mode entry), through the persistent compile cache when
-        active — after `warmup --serve` these are loads, not compiles.
-        Returns per-bucket timings + the cache hit/miss delta."""
+        """AOT-compile every configured (bucket, tier) pair now (server
+        startup / offline-mode entry), through the persistent compile
+        cache when active — after `warmup --serve` these are loads, not
+        compiles. Returns per-pair timings + the cache hit/miss delta."""
         # the postprocess import chain (train/evaluate and friends) is
         # first-request latency too — ~seconds in a fresh process, paid
         # inside the batcher thread if not paid here (measured via
@@ -480,11 +560,12 @@ class InferenceEngine:
         out: dict = {"buckets": []}
         with cache_delta() as d:
             for b in self.buckets:
-                t0 = time.perf_counter()
-                self._executable(b)
-                out["buckets"].append(
-                    {"bucket": list(b),
-                     "compile_s": round(time.perf_counter() - t0, 3)})
+                for tier in self.tiers:
+                    t0 = time.perf_counter()
+                    self._executable((b, tier))
+                    out["buckets"].append(
+                        {"bucket": list(b), "tier": tier,
+                         "compile_s": round(time.perf_counter() - t0, 3)})
         out["cache"] = d.stats()
         return out
 
@@ -503,6 +584,9 @@ class InferenceEngine:
                 "serve_batches": self._batches,
                 "serve_dispatch_failures": self._dispatch_failures,
                 "serve_bucket_splits": self._bucket_splits,
+                "serve_tier_splits": self._tier_splits,
+                "serve_requests_by_tier": dict(self._requests_by_tier),
+                "serve_responses_by_tier": dict(self._responses_by_tier),
                 "serve_timeout_flushes": self._timeout_flushes,
                 "serve_queue_depth": self._q.qsize(),
                 "serve_max_queue_depth": self._max_queue_depth,
@@ -512,6 +596,7 @@ class InferenceEngine:
                     if self._batches else None),
                 "serve_max_batch": self.max_batch,
                 "serve_buckets": len(self.buckets),
+                "serve_tiers": len(self.tiers),
             }
         if lat:
             out["serve_latency_p50_ms"] = round(

@@ -30,6 +30,12 @@ each replica process rebuilds the injector from the shared config and
 its own `DEEPOF_TPU_REPLICA` index, so fleet chaos runs reproduce from
 config alone.
 
+Replicas inherit the supervisor's exact serve ladder INCLUDING the
+precision tiers (`serve.precisions` round-trips through the replica
+config.json), so every replica can serve every (bucket, tier) pair
+while the router concentrates each pair's traffic on its affinity
+replica (serve/router.py folds the tier into the affinity map).
+
 `run_fleet` is the `serve --replicas N` entry: fleet + front router
 (serve/router.py) + a fleet heartbeat whose `fleet_*` counter block
 (evictions, respawns, failovers, shed, per-replica states) lands in

@@ -1,0 +1,181 @@
+"""Mixed-precision serving tiers: pure params->params weight transforms.
+
+The serve stack's speed/accuracy frontier is a FAMILY of operating
+points, not one (FlowNet 2.0's ladder, Flow Gym's per-request
+deployment choice — PAPERS.md); the cheapest new axis on that frontier
+is weight precision. This module owns the two quantized tiers and the
+protocol the whole stack (engine, warmup, router, serve_bench) shares:
+
+  f32   — identity: the restored checkpoint's native weights.
+  bf16  — every floating-point leaf cast to bfloat16: half the weight
+          bytes moved per dispatch. flax modules promote params to
+          their compute dtype at apply time, so activations stay f32
+          and the tier is bit-stable across dispatches (same inputs ->
+          same bits; pinned in tests/test_quant.py).
+  int8  — weight-only quantization of conv/deconv kernels with
+          per-OUTPUT-CHANNEL scales: q = round(w / scale) in [-127,127]
+          with scale = amax(|w|, all axes but the last) / 127. Biases
+          and norm params stay f32 (they are tiny and additive — a
+          bias quantization error shifts every pixel; a weight one
+          averages out over the receptive field). Dequantization
+          happens INSIDE the jitted forward (`dequantize_params` in
+          `engine.make_raw_forward`), so the executable's params input
+          is the int8 tree (quarter weight bytes) while activations
+          remain f32 — weight-only, activations untouched.
+
+Per-output-channel (not per-tensor) scales matter because conv kernels'
+channel dynamic ranges differ by orders of magnitude after training; a
+single tensor scale would crush small-range channels to a handful of
+int8 levels. Per-channel keeps the round-trip error of EVERY channel
+bounded by its own scale/2 (pinned in tests/test_quant.py).
+
+Both transforms are pure pytree->pytree functions of jnp ops only, so
+they are `jax.eval_shape`-traceable: `warmup --serve` derives each
+tier's params AVALS from an abstract init without materializing
+weights, and its lowering matches the engine's by construction (same
+cache key — the zero-recompile contract now spans bucket x tier).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: The tier vocabulary, cheapest-to-serve last. ServeConfig.precisions
+#: must be a subset; the config's FIRST entry is the default tier a
+#: request gets when it names none.
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: int8 symmetric range: round(w/scale) clipped to [-_QMAX, _QMAX].
+_QMAX = 127.0
+
+
+def resolve_precisions(cfg) -> tuple[str, ...]:
+    """The config's serve tier ladder, validated against PRECISIONS.
+
+    Order is preserved (the first entry is the default tier), duplicates
+    are rejected rather than deduped — a config naming a tier twice is a
+    typo, not a preference.
+    """
+    tiers = tuple(cfg.serve.precisions) or ("f32",)
+    seen = set()
+    for t in tiers:
+        if t not in PRECISIONS:
+            raise ValueError(
+                f"serve.precisions entry {t!r} unknown; valid tiers: "
+                f"{PRECISIONS}")
+        if t in seen:
+            raise ValueError(f"serve.precisions names {t!r} twice: {tiers}")
+        seen.add(t)
+    return tiers
+
+
+def _is_conv_kernel(name: str, leaf) -> bool:
+    """Quantization targets: multi-dim 'kernel' leaves (nn.Conv /
+    nn.ConvTranspose both store (spatial..., in, OUT) with output
+    channels LAST). Biases, norm scales/offsets, and scalar params pass
+    through untouched."""
+    ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+    return name == "kernel" and ndim >= 2
+
+
+def _quantize_kernel(w) -> dict:
+    """One conv kernel -> {"q": int8, "scale": f32[out_channels]}.
+
+    scale = per-output-channel amax / 127 (1.0 where a channel is all
+    zero, so dequantize is exact there); round-trip error is bounded by
+    scale/2 per channel.
+    """
+    w = jnp.asarray(w)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def _is_quantized_leaf(node) -> bool:
+    return (isinstance(node, Mapping) and set(node.keys()) == {"q", "scale"}
+            and getattr(node.get("q"), "dtype", None) == jnp.int8)
+
+
+def _cast_bf16(leaf):
+    arr = jnp.asarray(leaf)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        return arr.astype(jnp.bfloat16)
+    return arr
+
+
+def quantize_params(params, tier: str):
+    """Pure params->params transform for one tier (see module doc).
+
+    Works on real arrays AND ShapeDtypeStructs-under-eval_shape (warmup
+    derives tier avals abstractly). The returned tree is what the tier's
+    AOT executable takes as its params input.
+    """
+    if tier == "f32":
+        return params
+    if tier == "bf16":
+        return jax.tree_util.tree_map(_cast_bf16, params)
+    if tier != "int8":
+        raise ValueError(f"unknown precision tier {tier!r}; valid: "
+                         f"{PRECISIONS}")
+
+    def rec(node):
+        if isinstance(node, Mapping):
+            return {k: (_quantize_kernel(v) if _is_conv_kernel(k, v)
+                        else rec(v))
+                    for k, v in node.items()}
+        return node
+    return rec(params)
+
+
+def dequantize_params(params):
+    """Inverse of the int8 transform, applied INSIDE the jitted forward
+    (traced, so XLA fuses the dequantize into the weight load of each
+    conv): {"q", "scale"} leaves become f32 kernels; every other leaf —
+    f32 or bf16 — passes through for flax's own dtype promotion. A
+    no-op (structurally identical tree, zero inserted ops) on f32/bf16
+    trees, so the f32 path's HLO is unchanged from the pre-tier stack.
+    """
+    def rec(node):
+        if _is_quantized_leaf(node):
+            return (node["q"].astype(jnp.float32) * node["scale"])
+        if isinstance(node, Mapping):
+            return {k: rec(v) for k, v in node.items()}
+        return node
+    return rec(params)
+
+
+def int8_roundtrip_max_error(params) -> float:
+    """max over quantized kernels of (|w - dequant(quant(w))| / scale):
+    the per-channel error in SCALE units — the round-trip contract says
+    this never exceeds 0.5 (+ float eps). Test/diagnostic helper."""
+    quant = quantize_params(params, "int8")
+    worst = 0.0
+
+    def rec(orig, q) -> None:
+        nonlocal worst
+        if _is_quantized_leaf(q):
+            dq = np.asarray(q["q"], np.float32) * np.asarray(q["scale"])
+            err = np.abs(np.asarray(orig, np.float32) - dq)
+            worst = max(worst, float(np.max(err / np.asarray(q["scale"]))))
+        elif isinstance(q, Mapping):
+            for k in q:
+                rec(orig[k], q[k])
+
+    rec(params, quant)
+    return worst
+
+
+def params_nbytes(params) -> int:
+    """Total leaf bytes of a (possibly quantized) params tree — the
+    per-tier weight-memory figure serve_bench reports."""
+    return sum(int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+               * np.dtype(leaf.dtype).itemsize
+               for leaf in jax.tree_util.tree_leaves(params))
+
+

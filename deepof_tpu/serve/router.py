@@ -13,7 +13,11 @@ Three policies, in order:
   JPEG/BMP parse — no full decode at the front), maps them to the
   resolution ladder's bucket, and prefers replica `ladder_index % N` —
   a fixed affinity map, so bucket b's traffic concentrates on one
-  replica while every replica can still serve any bucket.
+  replica while every replica can still serve any bucket. Precision
+  tiers (serve/quant.py) fold into the same map: the ladder is the
+  FLATTENED (bucket x tier) grid and the body's `precision` field
+  joins the image-dimension probe, so each replica's hot executables
+  cover its (bucket, tier) slice.
 
   Load spill + shedding. Affinity yields when the preferred replica
   already has `fleet.spill_in_flight` requests in flight (default: one
@@ -50,6 +54,7 @@ from typing import Callable
 
 from ..core.config import ExperimentConfig
 from .buckets import pick_bucket, resolve_buckets
+from .quant import resolve_precisions
 
 #: JPEG start-of-frame markers that carry the image dimensions (all SOF
 #: variants; C4/C8/CC are huffman/arithmetic tables, not frames).
@@ -104,6 +109,11 @@ class Router:
         self.cfg = cfg
         self.fleet = fleet
         self.buckets = resolve_buckets(cfg)
+        # precision tiers fold into the affinity map: the ladder the
+        # replicas keep hot is (bucket, tier) pairs, so the router
+        # spreads that FLATTENED ladder across the fleet — bucket b at
+        # tier t concentrates on replica (b_idx * n_tiers + t_idx) % N
+        self.tiers = resolve_precisions(cfg)
         self.retries = max(int(fc.failover_retries), 0)
         self.max_in_flight = max(int(fc.max_in_flight), 1)
         # spill is a preference bound INSIDE the hard cap — past the cap
@@ -129,26 +139,34 @@ class Router:
         self._rr = itertools.count()  # unaffinitized round-robin cursor
 
     # ---------------------------------------------------------- routing
-    def _preferred(self, bucket: tuple[int, int] | None) -> int:
+    def _preferred(self, key) -> int:
+        """Affinity replica for a (bucket, tier) key: the flattened
+        (bucket x tier) ladder index modulo fleet size, so each
+        replica's hot AOT executables cover its slice of the full
+        ladder. With one tier this reduces to the pre-tier bucket map."""
+        bucket, tier = key if key is not None else (None, None)
         if bucket is None or bucket not in self.buckets:
             # probe failed / unknown shape: round-robin, not replica 0 —
             # an unprobeable workload must still spread across the fleet
             return next(self._rr) % max(self.fleet.size, 1)
-        return self.buckets.index(bucket) % max(self.fleet.size, 1)
+        t_idx = self.tiers.index(tier) if tier in self.tiers else 0
+        flat = self.buckets.index(bucket) * len(self.tiers) + t_idx
+        return flat % max(self.fleet.size, 1)
 
-    def _acquire(self, bucket, tried: set):
-        """Reserve an in-flight slot on the best candidate. Returns
-        (replica_snapshot, None) or (None, reason) where reason is
-        'unavailable' (no ready replica), 'overloaded' (all ready ones
-        saturated), or 'exhausted' (every ready replica already tried —
-        failover has nowhere left to replay)."""
+    def _acquire(self, key, tried: set):
+        """Reserve an in-flight slot on the best candidate for a
+        (bucket, tier) key. Returns (replica_snapshot, None) or
+        (None, reason) where reason is 'unavailable' (no ready
+        replica), 'overloaded' (all ready ones saturated), or
+        'exhausted' (every ready replica already tried — failover has
+        nowhere left to replay)."""
         ready = self.fleet.ready_replicas()
         if not ready:
             return None, "unavailable"
         cand = [r for r in ready if r.idx not in tried]
         if not cand:
             return None, "exhausted"
-        pref = self._preferred(bucket)
+        pref = self._preferred(key)
         n = max(self.fleet.size, 1)
         cand.sort(key=lambda r: (r.idx - pref) % n)
         with self._lock:
@@ -181,20 +199,31 @@ class Router:
         finally:
             conn.close()
 
-    def route_bucket(self, body: bytes) -> tuple[int, int] | None:
-        """Best-effort affinity bucket for a /v1/flow body: header-probe
-        the 'prev' image's dimensions without decoding it."""
+    def route_key(self, body: bytes):
+        """Best-effort affinity (bucket, tier) for a /v1/flow body:
+        header-probe the 'prev' image's dimensions without decoding it,
+        and read the declared `precision` (an unknown tier routes as
+        the default — the replica produces the structured 400, not the
+        front)."""
+        bucket = None
+        tier = self.tiers[0]
         try:
-            prev_b64 = json.loads(body).get("prev", "")
-            if not prev_b64:
-                return None
-            # the first ~KB of image bytes holds every header we parse;
-            # 4096 is 4-aligned, so a truncated prefix still decodes
-            raw = base64.b64decode(prev_b64[:4096])
-            hw = probe_image_hw(raw)
-            return pick_bucket(hw, self.buckets) if hw else None
+            req = json.loads(body)
+            p = req.get("precision")
+            if p in self.tiers:
+                tier = p
+            prev_b64 = req.get("prev", "")
+            if prev_b64:
+                # the first ~KB of image bytes holds every header we
+                # parse; 4096 is 4-aligned, so a truncated prefix still
+                # decodes
+                raw = base64.b64decode(prev_b64[:4096])
+                hw = probe_image_hw(raw)
+                if hw:
+                    bucket = pick_bucket(hw, self.buckets)
         except Exception:  # noqa: BLE001 - affinity is best-effort
             return None
+        return (bucket, tier) if bucket is not None else None
 
     def handle_flow(self, path: str, body: bytes,
                     ctype: str) -> tuple[int, bytes, str]:
@@ -202,11 +231,11 @@ class Router:
         always; a request admitted here cannot be silently dropped."""
         with self._lock:
             self._requests += 1
-        bucket = self.route_bucket(body)
+        key = self.route_key(body)
         tried: set[int] = set()
         last_error = None
         for attempt in range(self.retries + 1):
-            replica, reason = self._acquire(bucket, tried)
+            replica, reason = self._acquire(key, tried)
             if replica is None:
                 if reason == "exhausted":
                     break  # fall through to the structured 502

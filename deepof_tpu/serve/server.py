@@ -21,9 +21,13 @@ Two modes behind the `deepof_tpu serve` CLI verb:
 API:
   GET  /healthz           -> 200, the serve_* counter JSON
   POST /v1/flow           -> body {"prev": <b64 image>, "next": <b64>,
-                             "format": "json"|"flo"|"png"}
+                             "format": "json"|"flo"|"png",
+                             "precision": "f32"|"bf16"|"int8" (optional;
+                             must be in serve.precisions, default = its
+                             first entry)}
     json: {"flow_b64": <b64 raw float32 (H,W,2) little-endian>,
-           "shape": [H, W, 2], "bucket": [h, w], "latency_ms": ...}
+           "shape": [H, W, 2], "bucket": [h, w], "precision": tier,
+           "latency_ms": ...}
     flo:  application/octet-stream Middlebury .flo bytes
     png:  image/png flow-color rendering
   Errors are structured: 4xx/5xx with a ServeError payload
@@ -174,6 +178,7 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                     raise ServeError("bad_request",
                                      f"format must be json|flo|png, "
                                      f"got {fmt!r}")
+                precision = req.get("precision")  # None = default tier
                 prev = _decode_b64_image(req.get("prev", ""), "prev")
                 nxt = _decode_b64_image(req.get("next", ""), "next")
             except ServeError as e:
@@ -183,7 +188,7 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 self._reply_json(400, {"error": "bad_request",
                                        "message": f"{type(e).__name__}: {e}"})
                 return
-            fut = engine.submit(prev, nxt)
+            fut = engine.submit(prev, nxt, precision=precision)
             try:
                 res = fut.result(timeout=timeout_s)
             except ServeError as e:
@@ -213,6 +218,7 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 self._reply_json(200, {
                     "shape": list(flow.shape),
                     "bucket": list(res["bucket"]),
+                    "precision": res["precision"],
                     "native_hw": list(res["native_hw"]),
                     "latency_ms": round(res["latency_s"] * 1e3, 3),
                     "request_id": res["request_id"],
@@ -303,6 +309,7 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
                       "pid": os.getpid(),
                       "replica": replica_index(),
                       "buckets": [list(b) for b in engine.buckets],
+                      "precisions": list(engine.tiers),
                       "max_batch": engine.max_batch,
                       "warm": warm.get("cache")}), flush=True)
     try:

@@ -257,12 +257,16 @@ def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
 
 
 def warmup_serve(cfg: ExperimentConfig) -> dict:
-    """AOT-compile the serve bucket ladder into the persistent cache
-    (`warmup --serve`): one inference executable per configured shape
-    bucket, lowered exactly as `serve/engine.py:_executable` lowers at
-    runtime (shared `make_raw_forward` + `serve_avals`), so a later
-    engine's first request per bucket LOADS instead of compiling — zero
-    first-request XLA across the ladder (pinned in tests/test_serve.py).
+    """AOT-compile the serve ladder into the persistent cache
+    (`warmup --serve`): one inference executable per configured
+    (shape bucket, precision tier) pair, lowered exactly as
+    `serve/engine.py:_executable` lowers at runtime (shared
+    `make_raw_forward` + `serve_avals`, tier params avals derived
+    through the same `quantize_params` transform — abstractly, via
+    eval_shape), so a later engine's first request per (bucket, tier)
+    LOADS instead of compiling — zero first-request XLA across the
+    whole bucket x tier ladder (pinned in tests/test_serve.py and
+    tests/test_quant.py).
 
     No checkpoint needed: params enter as ShapeDtypeStructs from an
     eval_shape of model.init — warmup compiles executables for a
@@ -284,16 +288,19 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     from ..serve.buckets import resolve_buckets
     from ..serve.engine import (PAIR_CHANNELS, build_serve_model,
                                 make_raw_forward, serve_avals)
+    from ..serve.quant import quantize_params, resolve_precisions
 
     enable_for_config(cfg)
     model = build_serve_model(cfg)
     buckets = resolve_buckets(cfg)
+    tiers = resolve_precisions(cfg)
     max_batch = max(cfg.serve.max_batch, 1)
     fwd = jax.jit(make_raw_forward(model))
 
     out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
                            "backend": jax.default_backend(),
                            "cache_dir": jax.config.jax_compilation_cache_dir,
+                           "tiers": list(tiers),
                            "buckets": []}
     # everything inside the delta must be the bucket executables and
     # nothing else: abstract init (eval_shape over ShapeDtypeStructs
@@ -314,25 +321,34 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
             variables_sds = jax.eval_shape(
                 model.init, key_sds,
                 jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS), jnp.float32))
-            params_sds, x_sds = serve_avals(variables_sds["params"], bucket,
-                                            max_batch)
-            before_files = _entries()
-            bucket_delta = cache_delta()
-            t0 = time.perf_counter()
-            fwd.lower(params_sds, x_sds).compile()
-            bd = bucket_delta.stats()
-            # persisted = a new on-disk entry appeared (filesystem truth,
-            # not the counter's hope) OR the compile was already a hit
-            # (the entry predates this call). Neither => the 1 s floor
-            # swallowed it: compiled fine, persisted nothing.
-            wrote = bool(_entries() - before_files)
-            persisted = wrote or bd["hits"] >= 1
-            out["buckets"].append(
-                {"bucket": [h, w],
-                 "compile_s": round(time.perf_counter() - t0, 3),
-                 "persisted": persisted,
-                 "status": ("hit" if bd["hits"] >= 1
-                            else "persisted" if wrote else "skipped")})
+            for tier in tiers:
+                # the tier's params AVALS through the same transform the
+                # engine applies to real weights — abstract, so no
+                # weight bytes materialize and no helper compiles leak
+                # into the delta
+                tier_params_sds = jax.eval_shape(
+                    lambda p, _t=tier: quantize_params(p, _t),
+                    variables_sds["params"])
+                params_sds, x_sds = serve_avals(tier_params_sds, bucket,
+                                                max_batch)
+                before_files = _entries()
+                bucket_delta = cache_delta()
+                t0 = time.perf_counter()
+                fwd.lower(params_sds, x_sds).compile()
+                bd = bucket_delta.stats()
+                # persisted = a new on-disk entry appeared (filesystem
+                # truth, not the counter's hope) OR the compile was
+                # already a hit (the entry predates this call). Neither
+                # => the 1 s floor swallowed it: compiled fine,
+                # persisted nothing.
+                wrote = bool(_entries() - before_files)
+                persisted = wrote or bd["hits"] >= 1
+                out["buckets"].append(
+                    {"bucket": [h, w], "tier": tier,
+                     "compile_s": round(time.perf_counter() - t0, 3),
+                     "persisted": persisted,
+                     "status": ("hit" if bd["hits"] >= 1
+                                else "persisted" if wrote else "skipped")})
     out["cache"] = d.stats()
     out["persisted_buckets"] = sum(b["persisted"] for b in out["buckets"])
     out["skipped_buckets"] = sum(not b["persisted"] for b in out["buckets"])

@@ -15,7 +15,8 @@ import pytest
 
 from deepof_tpu.core.config import DataConfig
 from deepof_tpu.data.datasets import SyntheticData, _DecodedCache
-from deepof_tpu.data.pipeline import InputPipeline, derive_batch_rng
+from deepof_tpu.data.pipeline import (InputPipeline, derive_batch_rng,
+                                      resolve_num_workers)
 from deepof_tpu.data.prefetch import Prefetcher
 
 
@@ -45,6 +46,37 @@ def test_derive_batch_rng_deterministic_and_distinct():
     hi = derive_batch_rng(2**32, 3).randint(0, 2**31, 8)
     lo = derive_batch_rng(0, 3).randint(0, 2**31, 8)
     assert not np.array_equal(hi, lo)
+
+
+def test_resolve_num_workers_auto_mode():
+    """`data.num_workers = -1` (auto) sizes the pool to the host: 0 on
+    <= 2 cores — BENCH_r06 measured workers=4 at 49.5 vs workers=0 at
+    85.3 batches/s on a small host (thread contention, nothing to
+    overlap) — else min(4, cores - 2). Explicit values pass through."""
+    # explicit settings are never second-guessed
+    for n in (0, 1, 3, 7):
+        assert resolve_num_workers(n, cpu_count=1) == n
+    # only -1 means auto: a typo'd negative is rejected loudly
+    with pytest.raises(ValueError, match="-3"):
+        resolve_num_workers(-3)
+    # auto: small hosts get the inline path
+    assert resolve_num_workers(-1, cpu_count=1) == 0
+    assert resolve_num_workers(-1, cpu_count=2) == 0
+    # auto: leave 2 cores for the runtime, cap at 4
+    assert resolve_num_workers(-1, cpu_count=3) == 1
+    assert resolve_num_workers(-1, cpu_count=4) == 2
+    assert resolve_num_workers(-1, cpu_count=6) == 4
+    assert resolve_num_workers(-1, cpu_count=64) == 4
+    # the host-probe default resolves to SOMETHING valid
+    assert resolve_num_workers(-1) >= 0
+    # the pipeline itself honors auto (this container has <= 2 cores in
+    # CI, but assert only the invariant: pool size == resolution)
+    pipe = InputPipeline(lambda i: {"i": np.asarray([i])}, num_workers=-1)
+    try:
+        assert pipe.stats()["num_workers"] == resolve_num_workers(-1)
+        assert pipe.get()["i"][0] == 0  # auto mode still delivers
+    finally:
+        pipe.close()
 
 
 # ---------------------------------------------------- determinism contract

@@ -1,0 +1,385 @@
+"""Mixed-precision serving-tier tests (DESIGN.md "Precision tiers").
+
+Fast tier: the pure params->params transforms (int8 round-trip error
+bounded by scale/2 PER OUTPUT CHANNEL, bf16 cast, tier-vocabulary
+validation), the engine's (bucket, tier) batching + per-tier counters
+over the fake executor, the REAL flownet_s end-to-end pins — int8/bf16
+EPE vs f32 under a pinned threshold on seeded inputs, bf16 bit-stable
+across repeated dispatches — the HTTP `precision` field, router tier
+affinity over the flattened (bucket x tier) ladder, the serve_bench
+--precision schema, and analyze/tail surfacing of the per-tier counts.
+
+The slow-tier `warmup --serve` zero-recompile acceptance across the
+full bucket x tier ladder lives in tests/test_serve.py.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from deepof_tpu.core.config import get_config
+from deepof_tpu.serve.engine import InferenceEngine, ServeError
+from deepof_tpu.serve.quant import (PRECISIONS, dequantize_params,
+                                    int8_roundtrip_max_error, params_nbytes,
+                                    quantize_params, resolve_precisions)
+
+
+def _cfg(max_batch=4, timeout_ms=300.0, image_size=(32, 64),
+         precisions=("f32", "bf16", "int8"), **serve_kw):
+    cfg = get_config("flyingchairs")
+    return cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=image_size, gt_size=image_size),
+        serve=dataclasses.replace(cfg.serve, max_batch=max_batch,
+                                  batch_timeout_ms=timeout_ms,
+                                  precisions=precisions, **serve_kw),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir="/tmp/deepof_quant_test"))
+
+
+def _img(rng, hw=(30, 60)):
+    return rng.randint(1, 255, (*hw, 3), dtype=np.uint8)
+
+
+def _params_tree(rng):
+    """A flax-shaped tree: conv + deconv kernels with wildly different
+    per-channel dynamic ranges, biases, norm params, a scalar."""
+    k1 = rng.randn(3, 3, 6, 16).astype(np.float32)
+    k1 *= np.logspace(-3, 1, 16, dtype=np.float32)  # 4 decades across cout
+    return {
+        "conv1": {"kernel": k1, "bias": rng.randn(16).astype(np.float32)},
+        "decoder": {
+            "upconv1": {"kernel": rng.randn(4, 4, 16, 8).astype(np.float32)},
+            "pr1": {"kernel": rng.randn(3, 3, 8, 2).astype(np.float32),
+                    "bias": np.zeros(2, np.float32)}},
+        "norm": {"scale": np.ones(16, np.float32),
+                 "bias": np.zeros(16, np.float32)},
+        "k": np.float32(2.0),
+    }
+
+
+def _epe(a, b) -> float:
+    return float(np.mean(np.sqrt(np.sum((a - b) ** 2, axis=-1))))
+
+
+# --------------------------------------------------- pure transforms
+
+
+def test_resolve_precisions_validates_vocabulary():
+    assert resolve_precisions(_cfg(precisions=("f32",))) == ("f32",)
+    # order preserved: the first entry is the default tier
+    assert resolve_precisions(_cfg(precisions=("int8", "f32"))) \
+        == ("int8", "f32")
+    with pytest.raises(ValueError, match="fp4"):
+        resolve_precisions(_cfg(precisions=("f32", "fp4")))
+    with pytest.raises(ValueError, match="twice"):
+        resolve_precisions(_cfg(precisions=("f32", "f32")))
+    assert set(PRECISIONS) == {"f32", "bf16", "int8"}
+
+
+def test_int8_roundtrip_error_bounded_per_channel(rng):
+    """The quantization contract: for every conv kernel,
+    |w - dequant(quant(w))| <= scale/2 PER OUTPUT CHANNEL — the
+    per-channel scales keep small-dynamic-range channels exact to their
+    own half-step, which one per-tensor scale could not."""
+    params = _params_tree(rng)
+    assert int8_roundtrip_max_error(params) <= 0.5 + 1e-4
+
+    q = quantize_params(params, "int8")
+    # kernels became {"q": int8, "scale": f32[cout]}; everything else f32
+    assert q["conv1"]["kernel"]["q"].dtype == np.int8
+    assert q["conv1"]["kernel"]["scale"].shape == (16,)
+    assert q["decoder"]["upconv1"]["kernel"]["q"].dtype == np.int8
+    assert q["conv1"]["bias"].dtype == np.float32
+    assert q["norm"]["scale"].dtype == np.float32
+
+    # absolute per-channel bound, channel by channel
+    w = params["conv1"]["kernel"]
+    dq = np.asarray(q["conv1"]["kernel"]["q"], np.float32) \
+        * np.asarray(q["conv1"]["kernel"]["scale"])
+    scale = np.asarray(q["conv1"]["kernel"]["scale"])
+    for c in range(w.shape[-1]):
+        assert np.max(np.abs(w[..., c] - dq[..., c])) <= scale[c] / 2 + 1e-7
+
+    # dequantize restores plain f32 kernels (the tree model.apply takes)
+    restored = dequantize_params(q)
+    assert restored["conv1"]["kernel"].dtype == np.float32
+    assert restored["conv1"]["kernel"].shape == w.shape
+    # weight bytes: int8 tree is a fraction of the f32 tree
+    assert params_nbytes(q) < 0.4 * params_nbytes(params)
+
+
+def test_bf16_cast_and_f32_identity(rng):
+    params = _params_tree(rng)
+    b = quantize_params(params, "bf16")
+    assert b["conv1"]["kernel"].dtype == "bfloat16"
+    assert b["conv1"]["bias"].dtype == "bfloat16"
+    assert params_nbytes(b) == params_nbytes(params) // 2
+    # f32 is the identity — same objects, zero copies
+    assert quantize_params(params, "f32") is params
+    # dequantize is a structural no-op on unquantized trees
+    d = dequantize_params(params)
+    assert np.array_equal(d["conv1"]["kernel"], params["conv1"]["kernel"])
+    with pytest.raises(ValueError, match="unknown precision"):
+        quantize_params(params, "fp4")
+
+
+# ------------------------------------------- engine (bucket, tier) axis
+
+
+class _FakeForward:
+    """Counts dispatches and the keys they ran under."""
+
+    def __init__(self):
+        self.keys = []
+        self.lock = threading.Lock()
+
+    def __call__(self, bucket, x):
+        with self.lock:
+            self.keys.append(bucket)
+        return np.stack([x[..., 0] - x[..., 3], x[..., 1] - x[..., 4]],
+                        axis=-1).astype(np.float32)
+
+
+def test_engine_batches_per_tier_and_counts(rng):
+    """Requests on different tiers never share a dispatch; per-tier
+    request/response counts and the tier-split counter are live; an
+    unknown tier fails structured without touching the batcher."""
+    fake = _FakeForward()
+    with InferenceEngine(_cfg(max_batch=8, timeout_ms=60.0),
+                         forward_fn=fake) as eng:
+        futs = [(tier, eng.submit(*(_img(rng), _img(rng)), precision=tier))
+                for tier in ("f32", "int8", "int8", "bf16", None)]
+        for tier, f in futs:
+            r = f.result(timeout=30)
+            assert r["precision"] == (tier or "f32")
+        with pytest.raises(ServeError) as ei:
+            eng.submit(_img(rng), _img(rng), precision="fp4").result(
+                timeout=10)
+        assert ei.value.code == "bad_request"
+        assert "fp4" in str(ei.value)
+    stats = eng.stats()
+    assert stats["serve_requests_by_tier"] == {"f32": 2, "bf16": 1,
+                                               "int8": 2}
+    assert stats["serve_responses_by_tier"] == {"f32": 2, "bf16": 1,
+                                                "int8": 2}
+    assert stats["serve_tiers"] == 3
+    assert stats["serve_tier_splits"] >= 1
+    assert stats["serve_errors"] == 1
+    # the custom executor saw (bucket, tier)-pure dispatches: its first
+    # arg is always the plain bucket tuple (compat contract)
+    assert all(k == (32, 64) for k in fake.keys)
+
+
+def test_engine_real_model_tier_pins(rng):
+    """The acceptance pins on the REAL jit/AOT path (flownet_s 0.25,
+    seeded): (1) int8 and bf16 flows stay within a pinned EPE of the
+    f32 tier on identical inputs; (2) the bf16 tier is bit-stable
+    across repeated dispatches (same input -> same bits, whatever batch
+    it rode in); (3) warm() covers the full bucket x tier ladder."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.serve.engine import build_serve_model
+
+    cfg = _cfg(max_batch=2, timeout_ms=10.0)
+    model = build_serve_model(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 64, 6)))["params"]
+    a, b = _img(rng), _img(rng)
+    with InferenceEngine(cfg, model_params=(model, params)) as eng:
+        warm = eng.warm()
+        assert [(tuple(e["bucket"]), e["tier"]) for e in warm["buckets"]] \
+            == [((32, 64), t) for t in ("f32", "bf16", "int8")]
+        flows = {t: eng.submit(a, b, precision=t).result(timeout=300)["flow"]
+                 for t in ("f32", "bf16", "int8")}
+        bf16_again = eng.submit(a, b, precision="bf16").result(
+            timeout=300)["flow"]
+        int8_again = eng.submit(a, b, precision="int8").result(
+            timeout=300)["flow"]
+    # quantized tiers track f32 on seeded inputs (measured ~0.02-0.03 px
+    # at |flow| ~ 3 px on this seed; 0.2 px is the pinned ceiling)
+    assert _epe(flows["bf16"], flows["f32"]) < 0.2
+    assert _epe(flows["int8"], flows["f32"]) < 0.2
+    # and the quantized paths really are different operating points,
+    # not aliases of the f32 executable
+    assert not np.array_equal(flows["int8"], flows["f32"])
+    # deterministic across dispatches (padded fixed-occupancy batches)
+    np.testing.assert_array_equal(flows["bf16"], bf16_again)
+    np.testing.assert_array_equal(flows["int8"], int8_again)
+
+
+# ------------------------------------------------------ HTTP precision
+
+
+def test_http_precision_field(rng):
+    import base64
+    import http.client
+
+    from conftest import wait_for_listen
+
+    from deepof_tpu.serve.server import build_server
+
+    cfg = _cfg(max_batch=4, timeout_ms=20.0, host="127.0.0.1", port=0)
+    with InferenceEngine(cfg, forward_fn=_FakeForward()) as eng:
+        httpd = build_server(cfg, eng)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        wait_for_listen("127.0.0.1", port, timeout_s=20.0)
+        try:
+            def b64png(img):
+                ok, buf = cv2.imencode(".png", img)
+                assert ok
+                return base64.b64encode(buf.tobytes()).decode()
+
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            body = {"prev": b64png(_img(rng)), "next": b64png(_img(rng))}
+            conn.request("POST", "/v1/flow",
+                         json.dumps({**body, "precision": "int8"}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["precision"] == "int8"
+
+            # no field -> the config's default (first) tier
+            conn.request("POST", "/v1/flow", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["precision"] == "f32"
+
+            # unknown tier -> structured 400, batchmates unaffected
+            conn.request("POST", "/v1/flow",
+                         json.dumps({**body, "precision": "fp4"}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 400
+            err = json.loads(resp.read())
+            assert err["error"] == "bad_request"
+            assert "fp4" in err["message"]
+
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            assert health["serve_requests_by_tier"]["int8"] == 1
+            conn.close()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ------------------------------------------------- router tier affinity
+
+
+def test_router_affinity_spreads_bucket_tier_ladder(rng):
+    """The affinity map is the FLATTENED (bucket x tier) ladder mod N:
+    with 2 buckets x 3 tiers over 6 replicas every pair gets its own
+    replica; with one tier the map reduces to the pre-tier bucket map."""
+    import base64
+
+    from deepof_tpu.serve.router import Router
+
+    cfg = _cfg(buckets=((32, 64), (64, 64)))
+    router = Router(cfg, SimpleNamespace(size=6))
+
+    def body(hw, precision=None):
+        ok, buf = cv2.imencode(".png", _img(rng, hw))
+        assert ok
+        req = {"prev": base64.b64encode(buf.tobytes()).decode()}
+        if precision is not None:
+            req["precision"] = precision
+        return json.dumps(req).encode()
+
+    seen = {}
+    for hw, bucket in (((30, 60), (32, 64)), ((60, 60), (64, 64))):
+        for tier in ("f32", "bf16", "int8"):
+            key = router.route_key(body(hw, tier))
+            assert key == (bucket, tier)
+            seen[(bucket, tier)] = router._preferred(key)
+    assert sorted(seen.values()) == [0, 1, 2, 3, 4, 5]
+
+    # unknown tier routes as the default, the replica owns the 400
+    assert router.route_key(body((30, 60), "fp4")) == ((32, 64), "f32")
+    # no precision field -> default tier
+    assert router.route_key(body((30, 60))) == ((32, 64), "f32")
+
+    # single tier: identical to the pre-tier bucket-index map
+    r1 = Router(_cfg(precisions=("f32",), buckets=((32, 64), (64, 64))),
+                SimpleNamespace(size=2))
+    assert r1._preferred(((32, 64), "f32")) == 0
+    assert r1._preferred(((64, 64), "f32")) == 1
+
+
+# -------------------------------------- serve_bench --precision schema
+
+
+def _load_serve_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_bench.py")
+    spec = importlib.util.spec_from_file_location("serve_bench_q", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_bench_precision_schema_smoke():
+    sb = _load_serve_bench()
+    res = sb.precision_bench(requests=4, gap_ms=0.0, max_batch=2,
+                             timeout_ms=5.0, bucket=(32, 64),
+                             native_hw=(30, 60),
+                             tiers=("f32", "bf16", "int8"))
+    for key in sb.PRECISION_REQUIRED_KEYS:
+        assert key in res, f"precision_bench result missing {key!r}"
+    assert res["mode"] == "precision"
+    assert list(res["tiers"]) == ["f32", "bf16", "int8"]
+    for tier, block in res["tiers"].items():
+        for key in sb.TIER_REQUIRED_KEYS:
+            assert key in block, f"tier {tier} missing {key!r}"
+        assert block["errors"] == 0
+        assert block["requests_per_s"] > 0
+    assert res["tiers"]["f32"]["epe_vs_f32"] == 0.0
+    assert 0 < res["tiers"]["int8"]["epe_vs_f32"] < 0.2
+    assert res["tiers"]["bf16"]["weight_bytes"] \
+        < res["tiers"]["f32"]["weight_bytes"]
+    assert res["tiers"]["int8"]["weight_bytes"] \
+        < res["tiers"]["bf16"]["weight_bytes"]
+    json.dumps(res)  # JSON-line contract like bench.py
+
+
+# ------------------------------------------- analyze/tail per-tier
+
+
+def test_analyze_and_tail_surface_per_tier_counts(tmp_path):
+    from deepof_tpu.analyze import summarize, tail_summary
+
+    by_tier = {"f32": 9, "bf16": 0, "int8": 5}
+    serve_rec = {"kind": "serve", "step": 0, "time": time.time(),
+                 "serve_requests": 14, "serve_responses": 14,
+                 "serve_requests_by_tier": by_tier,
+                 "serve_tier_splits": 3, "serve_tiers": 3}
+    log_dir = str(tmp_path)
+    with open(os.path.join(log_dir, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps(serve_rec) + "\n")
+    with open(os.path.join(log_dir, "heartbeat.json"), "w") as f:
+        json.dump({"time": time.time(), "step": 14, "wedged": False,
+                   "serve_requests": 15,
+                   "serve_requests_by_tier": {**by_tier, "f32": 10}}, f)
+
+    s = summarize([serve_rec])
+    assert s["serve"]["requests_by_tier"] == by_tier
+    assert s["serve"]["tier_splits"] == 3
+
+    t = tail_summary(log_dir)
+    # the heartbeat (fresher) wins for the live block
+    assert t["serve"]["requests_by_tier"]["f32"] == 10
+    assert t["serve"]["requests_by_tier"]["int8"] == 5

@@ -504,14 +504,15 @@ def test_analyze_and_tail_surface_serve_counters(tmp_path):
 
 @pytest.mark.slow
 def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
-    """`warmup --serve` acceptance: after the serve ladder is AOT-
-    compiled into the persistent cache, a cold engine's FIRST requests
-    across ALL configured buckets load their executables (zero
-    recompiles) — asserted against warmup's per-bucket persisted/skipped
-    REPORT, not raw cache deltas: a bucket whose compile sat under jax's
-    1 s persistence floor legitimately recompiles in the next process
-    (flownet_s fwd-only does this intermittently — the pre-r06 flake),
-    while every bucket the report calls persisted must hit."""
+    """`warmup --serve` acceptance across the FULL bucket x tier ladder
+    (two buckets x three precision tiers): after the ladder is AOT-
+    compiled into the persistent cache, a cold engine's FIRST request on
+    every (bucket, tier) pair loads its executable (zero recompiles) —
+    asserted against warmup's per-pair persisted/skipped REPORT, not raw
+    cache deltas: a pair whose compile sat under jax's 1 s persistence
+    floor legitimately recompiles in the next process (flownet_s
+    fwd-only does this intermittently — the pre-r06 flake), while every
+    pair the report calls persisted must hit."""
     import jax
     import jax.numpy as jnp
 
@@ -521,8 +522,10 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
     prev = jax.config.jax_compilation_cache_dir
     try:
         buckets = ((64, 64), (64, 128))
+        tiers = ("f32", "bf16", "int8")
         cfg = _cfg(max_batch=2, timeout_ms=40.0, buckets=buckets,
-                   image_size=(64, 64), log_dir=str(tmp_path / "run"))
+                   image_size=(64, 64), log_dir=str(tmp_path / "run"),
+                   precisions=tiers)
         # the flagship model: its forward compiles comfortably above
         # jax's 1 s persistence floor on this host (the floor must stay
         # at 1 s per the hostmesh segfault note), so the report is
@@ -534,21 +537,23 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
                               compile_cache_dir=str(tmp_path / "xla_cache")))
 
         r1 = warmup.warmup_serve(cfg)
-        assert [b["bucket"] for b in r1["buckets"]] == [[64, 64], [64, 128]]
-        assert r1["cache"]["misses"] >= len(buckets)
+        ladder = len(buckets) * len(tiers)
+        assert [(tuple(b["bucket"]), b["tier"]) for b in r1["buckets"]] \
+            == [(b, t) for b in buckets for t in tiers]
+        assert r1["cache"]["misses"] >= ladder
         # the report is self-consistent and filesystem-backed
-        assert r1["persisted_buckets"] + r1["skipped_buckets"] == len(buckets)
+        assert r1["persisted_buckets"] + r1["skipped_buckets"] == ladder
         for b in r1["buckets"]:
             assert b["status"] in ("persisted", "hit", "skipped")
             assert b["persisted"] == (b["status"] != "skipped")
         if r1["persisted_buckets"]:
             assert os.listdir(tmp_path / "xla_cache")
-        persisted = {tuple(b["bucket"]) for b in r1["buckets"]
+        persisted = {(tuple(b["bucket"]), b["tier"]) for b in r1["buckets"]
                      if b["persisted"]}
         if not persisted:
-            pytest.skip("no bucket cleared the 1 s persistence floor on "
-                        "this host — nothing for the zero-recompile pin "
-                        "to assert")
+            pytest.skip("no (bucket, tier) cleared the 1 s persistence "
+                        "floor on this host — nothing for the "
+                        "zero-recompile pin to assert")
 
         jax.clear_caches()  # simulate a cold serving process
         model = build_serve_model(cfg)
@@ -557,23 +562,25 @@ def test_warmup_serve_then_first_requests_compile_nothing(tmp_path):
         rng = np.random.RandomState(0)
         with InferenceEngine(cfg, model_params=(model, params)) as eng:
             with warmup.cache_delta() as d:
-                futs = [eng.submit(_img(rng, (60, 60)), _img(rng, (60, 60))),
-                        eng.submit(_img(rng, (60, 120)),
-                                   _img(rng, (60, 120)))]
-                res = [f.result(timeout=300) for f in futs]
-        assert res[0]["bucket"] == (64, 64)
-        assert res[1]["bucket"] == (64, 128)
-        for r in res:
+                futs = [(hw, tier, eng.submit(_img(rng, hw), _img(rng, hw),
+                                              precision=tier))
+                        for hw in ((60, 60), (60, 120)) for tier in tiers]
+                res = [(hw, tier, f.result(timeout=600))
+                       for hw, tier, f in futs]
+        for hw, tier, r in res:
+            assert r["bucket"] == ((64, 64) if hw == (60, 60)
+                                   else (64, 128))
+            assert r["precision"] == tier
             assert np.isfinite(r["flow"]).all()
         delta = d.stats()
-        assert delta["requests"] >= len(buckets)  # counters are alive
-        # report-driven pin: persisted buckets load, skipped buckets are
+        assert delta["requests"] >= ladder  # counters are alive
+        # report-driven pin: persisted pairs load, skipped pairs are
         # ALLOWED to recompile (and only they are)
         assert delta["hits"] >= len(persisted), \
-            "a bucket warmup reported persisted recompiled — " \
+            "a (bucket, tier) warmup reported persisted recompiled — " \
             "warmup_serve's lowering drifted from the engine's"
-        assert delta["misses"] <= len(buckets) - len(persisted), \
-            f"more recompiles ({delta['misses']}) than skipped buckets " \
-            f"({len(buckets) - len(persisted)})"
+        assert delta["misses"] <= ladder - len(persisted), \
+            f"more recompiles ({delta['misses']}) than skipped pairs " \
+            f"({ladder - len(persisted)})"
     finally:
         warmup.enable_compile_cache(prev)

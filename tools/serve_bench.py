@@ -26,9 +26,21 @@ subprocesses behind the health-gated router, driven closed-loop by
 a 1-replica fleet — `speedup_vs_single` is the fleet scale-out win
 through the full HTTP + routing + supervision path.
 
+--precision [f32,bf16,int8] sweeps the mixed-precision serving tiers
+(serve/quant.py) through ONE real-model engine: per tier it reports
+requests/s, p50/p99 latency, the weight bytes each dispatch moves, and
+`epe_vs_f32` — mean endpoint error against the f32 tier's flows on the
+identical seeded synthetic pairs (the tier's accuracy cost as one
+number). Runs the real flownet_s forward (random init, or --log-dir's
+checkpoint), so expect seconds of compile per (bucket, tier) on a cold
+cache; honest note: on cpu proxies int8 rarely wins wall-clock — the
+tier exists for device windows where weight bandwidth is the limiter.
+
 Run: python tools/serve_bench.py [--requests 64] [--gap-ms 1]
      [--max-batch 8] [--timeout-ms 10] [--exec-ms 10] [--serial]
      python tools/serve_bench.py --fleet 2 [--clients 8]
+     python tools/serve_bench.py --precision f32,bf16,int8 \
+         [--requests 24] [--bucket 32x64]
 """
 
 from __future__ import annotations
@@ -63,6 +75,17 @@ FLEET_REQUIRED_KEYS = (
     "speedup_vs_single", "failovers", "shed", "max_batch", "exec_ms",
 )
 
+#: keys every --precision result carries at the top level ...
+PRECISION_REQUIRED_KEYS = (
+    "mode", "requests", "max_batch", "timeout_ms", "gap_ms", "bucket",
+    "precisions", "tiers",
+)
+#: ... and per tier inside result["tiers"][<tier>]
+TIER_REQUIRED_KEYS = (
+    "requests_per_s", "latency_p50_ms", "latency_p99_ms", "epe_vs_f32",
+    "errors", "wall_s", "weight_bytes",
+)
+
 
 def _bench_cfg(bucket: tuple[int, int], max_batch: int, timeout_ms: float,
                log_dir: str | None):
@@ -94,13 +117,14 @@ def _real_model_params(cfg):
     return model, variables["params"]
 
 
-def run_workload(engine: InferenceEngine, requests: list, gap_ms: float):
+def run_workload(engine: InferenceEngine, requests: list, gap_ms: float,
+                 precision: str | None = None):
     """Open-loop arrival: submit with a fixed inter-arrival gap, then
     wait for every future. Returns (wall_s, errors, results)."""
     t0 = time.perf_counter()
     futures = []
     for prev, nxt in requests:
-        futures.append(engine.submit(prev, nxt))
+        futures.append(engine.submit(prev, nxt, precision=precision))
         if gap_ms > 0:
             time.sleep(gap_ms / 1e3)
     results, errors = [], 0
@@ -159,6 +183,75 @@ def serve_bench(requests: int = 64, gap_ms: float = 1.0, max_batch: int = 8,
         out["serial_wall_s"] = round(swall, 4)
         out["serial_requests_per_s"] = round((len(pairs) - serr) / swall, 2)
         out["speedup_vs_serial"] = round(swall / wall, 2) if wall > 0 else None
+    return out
+
+
+# --------------------------------------------------------- precision
+
+
+def _percentile_ms(latencies_s: list, frac: float):
+    if not latencies_s:
+        return None
+    lat = sorted(latencies_s)
+    return round(1e3 * lat[int(frac * (len(lat) - 1))], 3)
+
+
+def precision_bench(requests: int = 24, gap_ms: float = 0.5,
+                    max_batch: int = 4, timeout_ms: float = 5.0,
+                    bucket: tuple[int, int] = (32, 64),
+                    native_hw: tuple[int, int] = (30, 60),
+                    tiers: tuple[str, ...] = ("f32", "bf16", "int8"),
+                    log_dir: str | None = None) -> dict:
+    """Sweep the precision tiers through ONE engine on the REAL model
+    forward: per tier, requests/s + p50/p99 over the identical seeded
+    workload, the tier's params-tree bytes, and mean-EPE of its flows
+    against the f32 tier's (the accuracy cost of the operating point).
+    f32 always runs (it is the EPE reference), first."""
+    from deepof_tpu.serve.quant import params_nbytes, resolve_precisions
+
+    tiers = tuple(t for t in tiers if t != "f32")
+    tiers = ("f32",) + tiers  # reference tier first, exactly once
+    cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    cfg = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                precisions=tiers))
+    resolve_precisions(cfg)  # fail fast on an unknown tier name
+    model_params = (_real_model_params(cfg) if not log_dir else None)
+
+    rng = np.random.RandomState(0)
+    pairs = [(rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8),
+              rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8))
+             for _ in range(max(int(requests), 1))]
+
+    out = {"mode": "precision", "requests": len(pairs),
+           "max_batch": max_batch, "timeout_ms": timeout_ms,
+           "gap_ms": gap_ms, "bucket": list(bucket),
+           "precisions": list(tiers), "tiers": {}}
+    f32_flows = None
+    with InferenceEngine(cfg, model_params=model_params) as engine:
+        engine.warm()
+        for tier in tiers:
+            wall, errors, results = run_workload(engine, pairs, gap_ms,
+                                                 precision=tier)
+            flows = [r["flow"] if r is not None else None for r in results]
+            if tier == "f32":
+                f32_flows = flows
+            epe = None
+            if f32_flows is not None:
+                deltas = [float(np.mean(np.sqrt(np.sum((a - b) ** 2, -1))))
+                          for a, b in zip(flows, f32_flows)
+                          if a is not None and b is not None]
+                epe = round(float(np.mean(deltas)), 6) if deltas else None
+            lats = [r["latency_s"] for r in results if r is not None]
+            out["tiers"][tier] = {
+                "wall_s": round(wall, 4),
+                "requests_per_s": round((len(pairs) - errors) / wall, 2),
+                "latency_p50_ms": _percentile_ms(lats, 0.50),
+                "latency_p99_ms": _percentile_ms(lats, 0.99),
+                "epe_vs_f32": epe,
+                "errors": errors,
+                "weight_bytes": params_nbytes(
+                    engine._params_by_tier[tier]),
+            }
     return out
 
 
@@ -331,13 +424,27 @@ def main(argv=None) -> int:
                          "clients) against a 1-replica fleet")
     ap.add_argument("--clients", type=int, default=8,
                     help="fleet mode: concurrent closed-loop HTTP clients")
+    ap.add_argument("--precision", nargs="?", const="f32,bf16,int8",
+                    default=None, metavar="TIERS",
+                    help="sweep mixed-precision serving tiers (comma "
+                         "list; bare flag = f32,bf16,int8) on the real "
+                         "model: per-tier requests/s, p50/p99, weight "
+                         "bytes, and epe_vs_f32 on seeded pairs")
     args = ap.parse_args(argv)
 
     def hw(spec):
         h, w = spec.lower().split("x")
         return (int(h), int(w))
 
-    if args.fleet is not None:
+    if args.precision is not None:
+        res = precision_bench(
+            requests=args.requests, gap_ms=args.gap_ms,
+            max_batch=args.max_batch, timeout_ms=args.timeout_ms,
+            bucket=hw(args.bucket), native_hw=hw(args.native),
+            tiers=tuple(t.strip() for t in args.precision.split(",")
+                        if t.strip()),
+            log_dir=args.log_dir)
+    elif args.fleet is not None:
         res = fleet_bench(replicas=args.fleet, requests=args.requests,
                           clients=args.clients, max_batch=args.max_batch,
                           timeout_ms=args.timeout_ms, exec_ms=args.exec_ms,
