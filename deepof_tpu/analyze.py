@@ -127,6 +127,15 @@ def _fleet_counters(rec: dict) -> dict:
             if k.startswith("fleet_") and v is not None}
 
 
+def _elastic_counters(rec: dict) -> dict:
+    """`elastic_*` counters from one record or heartbeat sample (the
+    elastic-training block, train/elastic.py: generation, re-forms,
+    lost hosts, resumed step, steps lost, per-host states). `tail`
+    exits 5 when the block shows the run had to re-form."""
+    return {k[len("elastic_"):]: v for k, v in rec.items()
+            if k.startswith("elastic_") and v is not None}
+
+
 def summarize(records: list[dict]) -> dict:
     by_kind: dict[str, list[dict]] = defaultdict(list)
     for r in records:
@@ -186,6 +195,15 @@ def summarize(records: list[dict]) -> dict:
         fleet = _fleet_counters(serves[-1])
         if fleet:
             out["fleet"] = fleet
+
+    elastics = by_kind.get("elastic", [])
+    if elastics:
+        # cumulative: the newest elastic record carries the whole run's
+        # re-form history (train/elastic.py appends one per re-form and
+        # one at shutdown)
+        elastic = _elastic_counters(elastics[-1])
+        if elastic:
+            out["elastic"] = elastic
 
     warns = by_kind.get("warn", [])
     if warns:
@@ -302,6 +320,12 @@ def tail_summary(log_dir: str, recent: int = 10,
         fleet = _fleet_counters(hb)
         if fleet:
             out["fleet"] = fleet
+        # an elastic coordinator's heartbeat carries the live elastic_*
+        # block (generation, re-forms, lost hosts, steps lost, per-host
+        # states) — `tail` exits 5 when the run had to re-form
+        elastic = _elastic_counters(hb)
+        if elastic:
+            out["elastic"] = elastic
 
     serves = [r for r in records if r.get("kind") == "serve"]
     if serves:
@@ -313,6 +337,12 @@ def tail_summary(log_dir: str, recent: int = 10,
             fleet = _fleet_counters(serves[-1])
             if fleet:
                 out["fleet"] = fleet
+    if "elastic" not in out:
+        elastics = [r for r in records if r.get("kind") == "elastic"]
+        if elastics:
+            elastic = _elastic_counters(elastics[-1])
+            if elastic:
+                out["elastic"] = elastic
     return out
 
 

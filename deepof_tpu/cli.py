@@ -128,6 +128,24 @@ def main(argv=None) -> int:
                               "<log-dir>/trace.json (Perfetto/"
                               "chrome://tracing loadable) — shorthand "
                               "for --set obs.trace=true")
+    p_train.add_argument("--elastic", type=int, default=None, metavar="N",
+                         help="elastic multi-host training (DESIGN.md "
+                              "\"Elastic training\"): supervise N "
+                              "single-host trainer subprocesses that "
+                              "survive host loss/preemption — a lost or "
+                              "wedged host triggers a generation bump: "
+                              "clean barrier stop, re-form on the "
+                              "survivors (re-sharded data streams), "
+                              "resume from the newest verified "
+                              "checkpoint. Requires --max-steps (the "
+                              "absolute target step). Overrides "
+                              "elastic.hosts; <= 1 keeps plain training")
+    p_train.add_argument("--host-index", type=int, default=None,
+                         help=argparse.SUPPRESS)  # elastic-internal:
+    #                      trainer children carry their host identity
+    p_train.add_argument("--config-json", default=None,
+                         help=argparse.SUPPRESS)  # elastic-internal:
+    #                      children load the coordinator's exact config
 
     p_eval = sub.add_parser("eval", help="evaluate latest checkpoint")
     _add_common(p_eval)
@@ -234,7 +252,9 @@ def main(argv=None) -> int:
         "tail", help="one-glance health of a live or finished run: step, "
                      "loss, recent vs overall throughput, phase shares, "
                      "starvation, resilience counters, heartbeat age; "
-                     "exits nonzero if the heartbeat reports wedged")
+                     "exits 3 when the heartbeat reports wedged, 4 when "
+                     "a serving fleet evicted or broke a replica, 5 "
+                     "when an elastic run lost a host and re-formed")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
@@ -286,6 +306,13 @@ def main(argv=None) -> int:
             fleet = summary.get("fleet") or {}
             if fleet.get("broken") or fleet.get("evictions"):
                 return 4
+            # rc 5 when an elastic run lost a host and re-formed (or
+            # aborted hosts without re-forming): the run may have
+            # completed to target, but an operator must see that the
+            # world shrank — distinct from wedged (3) and fleet (4)
+            elastic = summary.get("elastic") or {}
+            if elastic.get("reforms") or elastic.get("lost_hosts"):
+                return 5
             if not args.follow:
                 return 0
             import time as _time
@@ -328,6 +355,65 @@ def main(argv=None) -> int:
     if args.cmd == "config":
         print(json.dumps(dataclasses.asdict(cfg), indent=2, default=str))
         return 0
+
+    if args.cmd == "train":
+        if getattr(args, "host_index", None) is not None:
+            cfg = cfg.replace(elastic=dataclasses.replace(
+                cfg.elastic, host_index=args.host_index))
+        hosts = (args.elastic if args.elastic is not None
+                 else cfg.elastic.hosts)
+        if hosts and hosts > 1 and cfg.elastic.host_index < 0:
+            # coordinator mode (train/elastic.py): supervise the pool —
+            # dispatched BEFORE jax.distributed/backend init so the
+            # supervisor process stays jax-free
+            if getattr(args, "multihost", False):
+                raise SystemExit(
+                    "train: --elastic and --multihost are exclusive — "
+                    "elastic mode supervises one single-host trainer "
+                    "process per host itself")
+            if args.epochs is not None:
+                raise SystemExit("train: elastic mode needs an absolute "
+                                 "target step (--max-steps), not --epochs")
+            # the train-package import chain below initializes a jax
+            # backend (orbax does, at import): the coordinator must
+            # defuse it FIRST, in EVERY mode — it computes nothing, a
+            # wedged device tunnel could hang the supervisor itself
+            # (the exact process the elastic layer exists to keep
+            # alive), and on a real pod an accelerator-holding
+            # supervisor would starve the trainer child it spawns on
+            # the same host (device access is exclusive per process;
+            # children acquire the real backend themselves when
+            # elastic.virtual_devices=0)
+            from .core.hostmesh import force_cpu_devices
+
+            force_cpu_devices(1)  # supervisor computes nothing
+            from .train.elastic import run_elastic
+
+            try:
+                return run_elastic(cfg, hosts=hosts,
+                                   max_steps=args.max_steps)
+            except ValueError as e:
+                raise SystemExit(f"train --elastic: {e}")
+
+    if (args.cmd in ("train", "eval")
+            and cfg.elastic.host_index >= 0
+            and cfg.elastic.virtual_devices > 0):
+        # elastic trainer child in virtual-host mode: force its private
+        # CPU device slice BEFORE any backend init (core/hostmesh.py —
+        # env vars alone do not defuse the container's axon backend)
+        from .core.hostmesh import force_cpu_devices
+
+        force_cpu_devices(cfg.elastic.virtual_devices)
+        if cfg.train.compile_cache is not True:
+            # force_cpu_devices enables the suite's persistent compile
+            # cache, but CONCURRENT trainer children reading entries
+            # another process wrote is exactly the cpu cache-read heap
+            # corruption bisected in r06 (TrainConfig.compile_cache):
+            # the pool segfaults mid-drill. Keep the cpu auto-off
+            # default real for children; compile_cache=true opts in.
+            from .train.warmup import disable_compile_cache
+
+            disable_compile_cache()
 
     if getattr(args, "multihost", False):
         import jax

@@ -402,6 +402,88 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic multi-host training (train/elastic.py, DESIGN.md "Elastic
+    training"): a stdlib coordinator supervises N single-host trainer
+    subprocesses and survives host loss/preemption without operator
+    action. On a lost or wedged host the coordinator bumps the
+    **generation**: survivors are stopped at a clean barrier (SIGTERM ->
+    verified checkpoint + exit 0), the world re-forms on the survivors
+    (new host count, per-host data streams re-sharded with the
+    generation folded in as a salt), and every survivor respawns from
+    the newest VALID checkpoint in the shared directory. Lost work is
+    bounded by the checkpoint cadence.
+
+    Two roles share this config: the COORDINATOR (`train --elastic N`;
+    ``hosts`` > 1 and ``host_index`` < 0) and the per-host TRAINER
+    children it spawns (``host_index`` >= 0; the coordinator serializes
+    each child's exact config — world size, generation, shared ckpt
+    dir — to <log_dir>/host-<i>/config.json)."""
+
+    # coordinator world size; 0/1 = plain single-process training (the
+    # `train --elastic N` CLI flag overrides this)
+    hosts: int = 0
+    # abort instead of re-forming below this many surviving hosts
+    min_hosts: int = 1
+    # --- per-child identity (written by the coordinator; -1/-0 defaults
+    # mean "not an elastic child") ---
+    host_index: int = -1
+    num_hosts: int = 0  # current generation's world size
+    generation: int = 0
+    # the host that owns checkpoint WRITES this generation (the lowest
+    # surviving host index); every host restores from the shared dir
+    primary_host: int = 0
+    # absolute global step the run trains to (elastic runs need an
+    # absolute target so a respawned trainer stops where the run ends,
+    # not `max_steps` further); `train --elastic N --max-steps T` sets it
+    target_step: int = 0
+    # shared verified-checkpoint directory ("" = <log_dir>/ckpt); the
+    # primary writes it, every trainer restores from it on (re)spawn
+    ckpt_dir: str = ""
+    # step-skew limiter: a host pauses (heartbeat-touched, so it never
+    # reads as a stall) while it is more than this many steps ahead of
+    # the slowest live host (the coordinator publishes the world floor
+    # to `world_file` each poll). Real synchronous data-parallel is
+    # lockstepped by its collectives; virtual hosts are independent
+    # processes, and unbounded skew would void the elastic guarantee
+    # that lost work <= the checkpoint cadence (the furthest host's
+    # uncommitted tail is what a re-form discards). The floor advances
+    # at heartbeat/poll granularity, so size this to AT LEAST the steps
+    # one obs.heartbeat_period_s covers or the limiter throttles
+    # healthy leaders; 0 disables.
+    sync_ahead: int = 4
+    # path of the coordinator's world-floor file (written by the
+    # coordinator into each child's config; "" = pacing off)
+    world_file: str = ""
+    # force this many virtual CPU devices per trainer child
+    # (core/hostmesh.py) — the whole pool is testable on one host; 0 =
+    # use the real backend's devices (an actual per-host accelerator)
+    virtual_devices: int = 1
+    # --- coordinator supervision knobs (fleet.py lineage) ---
+    poll_s: float = 0.5
+    # a trainer heartbeat.json older than this is a lost host (heartbeat
+    # rewrites every obs.heartbeat_period_s; size to several periods)
+    stale_after_s: float = 15.0
+    # content-stall verdict: a host whose heartbeat shows >= 1 completed
+    # step but no step/touch activity for this long is wedged (its OWN
+    # watchdog needs obs.watchdog_min_s — default 60 s — and 3 beats to
+    # arm; the coordinator judges earlier). Gated on beats >= 1 so the
+    # first-dispatch XLA compile is never judged. 0 disables.
+    wedge_after_s: float = 45.0
+    # how long a spawned trainer may take to write its first heartbeat
+    # (model build + restore + first allocations) before the spawn is
+    # declared failed and the world re-forms without it
+    spawn_timeout_s: float = 300.0
+    # barrier: how long survivors get to save + exit 0 after SIGTERM
+    # before SIGKILL escalation (must cover one checkpoint write)
+    barrier_timeout_s: float = 120.0
+    term_grace_s: float = 10.0
+    # give up re-forming after this many generations (a fault that
+    # keeps killing hosts is a defect to surface, not to retry forever)
+    max_reforms: int = 16
+
+
+@dataclass(frozen=True)
 class ResilienceConfig:
     """Fault-tolerance layer (deepof_tpu/resilience/, DESIGN.md
     "Resilience"): the self-healing data path, verified checkpoints, the
@@ -473,6 +555,7 @@ class ExperimentConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

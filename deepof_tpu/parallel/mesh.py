@@ -103,6 +103,41 @@ def local_batch_rows(mesh: Mesh, global_batch: int) -> tuple[int, list[int]]:
     return len(rows), rows
 
 
+def elastic_stream_seed(seed: int, host_index: int, num_hosts: int,
+                        generation: int, start_step: int) -> np.ndarray:
+    """Base seed of one elastic trainer host's data-sampling stream
+    (train/elastic.py; the elastic counterpart of `data_stream_seed`).
+
+    The world re-forms when a host is lost: the survivors respawn with a
+    new ``num_hosts`` and a bumped ``generation``, and every host's
+    stream must (a) stay a pure function of the config — the whole run
+    reproduces from (seed, fault schedule) alone — and (b) decorrelate
+    from every other (host, world-size, generation) stream, so no
+    survivor replays draws the old world already trained on and the
+    post-reform shards are disjoint by construction. All five components
+    are folded in losslessly as uint32 words (MT19937 ``init_by_array``
+    via `data/pipeline.py::derive_batch_rng`, which derives one sibling
+    rng per batch index from this base): the seed as a 64-bit word pair,
+    then host, world size, generation, and the resume step — any
+    differing component yields an unrelated stream. The layout is also
+    longer than `data_stream_seed`'s two words, so an elastic host never
+    collides with a plain single-host run at the same seed.
+
+    ``host_index`` may EXCEED ``num_hosts``: survivors keep their
+    original identity across re-forms (host 2 of original 3 stays
+    "host 2" in the shrunken 2-host world — renumbering would let a
+    host-indexed fault schedule re-fire on an innocent neighbor), so
+    the index is an identity, not a coordinate.
+    """
+    if int(host_index) < 0 or int(num_hosts) < 1:
+        raise ValueError(f"invalid elastic identity: host_index "
+                         f"{host_index}, num_hosts {num_hosts}")
+    s = int(seed)
+    return np.array([s & 0xFFFFFFFF, (s >> 32) & 0xFFFFFFFF,
+                     int(host_index), int(num_hosts), int(generation),
+                     int(start_step)], dtype=np.uint32)
+
+
 def process_seed(mesh: Mesh, seed: int) -> int:
     """Host-sampling seed: decorrelated across data shards, *identical*
     for processes that are replicas of the same data coordinate (their

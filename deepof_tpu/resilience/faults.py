@@ -88,6 +88,25 @@ class FaultConfig:
     replica_crash_at: tuple[int, ...] = ()
     replica_wedge_at: tuple[int, ...] = ()
     replica_fault_after: int = 8
+    # host-level acting sites (elastic training chaos, train/elastic.py
+    # maybe_host_fault): the site index is the TRAINER HOST index
+    # (cfg.elastic.host_index — the coordinator writes it into each
+    # trainer's config.json), and the fault arms once that host's
+    # global step reaches `host_fault_step` — "mid-run" by
+    # construction. host_loss = SIGKILL the trainer process (a
+    # preempted/OOM-killed/vanished pod host); host_wedge = the main
+    # loop blocks forever after a step (a hung device dispatch — the
+    # coordinator's content-stall verdict exists for exactly this);
+    # preempt_notice = SIGTERM self-delivery (the cloud's preemption
+    # warning — the trainer's graceful handler saves a verified
+    # checkpoint and exits 0, and the coordinator re-forms without
+    # it). Each trainer incarnation rebuilds the injector from config;
+    # a lost host is never respawned under the same index, so a host
+    # site fires at most once per run.
+    host_loss_at: tuple[int, ...] = ()
+    host_wedge_at: tuple[int, ...] = ()
+    preempt_notice_at: tuple[int, ...] = ()
+    host_fault_step: int = 0
     # how many checks of one (site, index) fault before it recovers:
     # 1 = transient (first retry succeeds); data_retries + 1 = exhausts
     # the retry budget and forces quarantine + substitution; a large
@@ -97,7 +116,8 @@ class FaultConfig:
 
 _SITES = ("decode", "assemble", "fetch", "ckpt_save", "ckpt_restore",
           "dispatch", "ckpt_truncate", "ckpt_corrupt",
-          "replica_crash", "replica_wedge")
+          "replica_crash", "replica_wedge",
+          "host_loss", "host_wedge", "preempt_notice")
 
 
 def _u01(seed: int, site: str, index: int) -> float:

@@ -34,7 +34,8 @@ from .state import TrainState
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, create: bool = True,
                  async_save: bool = True, verify: bool = True,
-                 log=None, injector=None, config_digest: str | None = None):
+                 log=None, injector=None, config_digest: str | None = None,
+                 writer: bool = True, info_log=None):
         """create=False opens read-only (no mkdir side effect — e.g. the
         transfer-init source, where a typo'd path must not leave a phantom
         empty run directory behind).
@@ -57,13 +58,27 @@ class CheckpointManager:
         (the chaos-test substrate).
         config_digest: recorded in each manifest; restore warns (but
         proceeds) on mismatch — fine-tune handoffs legitimately cross
-        configs."""
+        configs.
+        info_log: optional (step, message) sink for INFORMATIONAL
+        records (restore provenance) — wired to kind="info" by the
+        Trainer so a healthy restore never lands on the operator's
+        warnings surface; falls back to `log` when absent, so
+        single-sink users still get the provenance audit trail.
+        writer: False opens the directory restore-only — save() is a
+        silent no-op returning None. Elastic non-primary trainer hosts
+        share the primary's checkpoint directory (train/elastic.py):
+        they must resume from it on every re-form, but concurrent
+        writers at different steps would race the prune/clobber
+        directory surgery, so exactly one host (the generation's
+        primary) writes."""
         self.directory = os.path.abspath(directory)
         self.keep = keep
         self._verify = verify
         self._log = log
         self._inj = injector
         self._config_digest = config_digest
+        self._writer = writer
+        self._info_log = info_log
         self._pending_manifest: tuple[int, dict] | None = None
         # recovery-event counters (GIL-atomic int bumps; heartbeat reads)
         self._saves = 0
@@ -192,6 +207,8 @@ class CheckpointManager:
         """Write a checkpoint; on failure (disk full, injected fault),
         degrade to a logged warning and return None — the previous
         checkpoint stays the resume/rollback target."""
+        if not self._writer:
+            return None  # restore-only handle (elastic non-primary host)
         step = int(jax.device_get(state.step))
         self._wait()  # serialize with any still-writing previous save
         path = self._path(step)
@@ -319,9 +336,23 @@ class CheckpointManager:
                 continue
             if i > 0:
                 self._restore_fallbacks += 1
-                self._warn(s,
-                           f"restored fallback checkpoint step {s} "
-                           f"({i} newer checkpoint(s) skipped as invalid)")
+            # restore provenance, auditable from metrics.jsonl alone: a
+            # post-reform / post-rollback run states WHICH step it came
+            # back from and WHY (requested vs newest vs fallback after
+            # corruption), so "where did these params come from" never
+            # needs the checkpoint directory's history reconstructed
+            why = ("explicitly requested"
+                   if step is not None else
+                   "newest checkpoint" if i == 0 else
+                   f"fallback after corruption: {i} newer candidate(s) "
+                   "failed verification/restore")
+            msg = f"checkpoint restore: step {s} ({why})"
+            if self._info_log is not None:
+                self._info_log(s, msg)
+            elif self._log is not None:
+                self._log(s, msg)
+            elif i > 0:  # a healthy sink-less restore stays quiet
+                warnings.warn(msg, RuntimeWarning, stacklevel=2)
             return restored.replace(tx=template.tx)
         return None
 

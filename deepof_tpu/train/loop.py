@@ -45,6 +45,7 @@ from .metrics_log import (
     StepTimer,
     SyncFetcher,
 )
+from .elastic import maybe_host_fault, pace_to_world
 from .schedule import step_decay_schedule
 from .state import create_train_state, make_optimizer
 from .step import make_eval_fn, make_train_step
@@ -141,7 +142,19 @@ class Trainer:
         # run before loads executables instead of recompiling — the
         # execution layer's "start hot" half (train/warmup.py).
         enable_for_config(cfg)
-        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        # An elastic trainer child (train/elastic.py) in virtual-host
+        # mode owns exactly elastic.virtual_devices of the forced CPU
+        # platform — each member of the pool gets its own private mesh.
+        el = cfg.elastic
+        self._elastic_child = el.host_index >= 0 and el.num_hosts > 0
+        if mesh is not None:
+            self.mesh = mesh
+        elif self._elastic_child and el.virtual_devices > 0:
+            from ..parallel.mesh import local_mesh
+
+            self.mesh = local_mesh(el.virtual_devices)
+        else:
+            self.mesh = build_mesh(cfg.mesh)
         self.dataset = dataset if dataset is not None else build_dataset(cfg.data)
         t = cfg.data.time_step
         flow_channels = 2 * (t - 1)
@@ -177,12 +190,30 @@ class Trainer:
             self.logger.log("warn", 0,
                             message="fault injection ENABLED "
                                     f"({cfg.resilience.faults})")
+        # Elastic children share one verified-checkpoint directory: the
+        # generation's PRIMARY host writes it, every host restores from
+        # it on (re)spawn — so a re-formed world resumes from one
+        # consistent state and a lost primary's torn last write falls
+        # back to the previous valid step (train/elastic.py).
+        ckpt_dir = (el.ckpt_dir if self._elastic_child and el.ckpt_dir
+                    else cfg.train.log_dir + "/ckpt")
+        ckpt_writer = (not self._elastic_child
+                       or el.host_index == el.primary_host)
+        # the advisory config digest must be identical across hosts and
+        # generations of ONE elastic run (only per-host identity and the
+        # host-local log_dir differ), or every re-form would warn about
+        # a cross-config restore
+        digest_src = cfg if not self._elastic_child else cfg.replace(
+            train=dataclasses.replace(cfg.train, log_dir=""),
+            elastic=type(el)())
         self.ckpt = CheckpointManager(
-            cfg.train.log_dir + "/ckpt", keep=cfg.train.keep_ckpts,
+            ckpt_dir, keep=cfg.train.keep_ckpts,
             verify=cfg.resilience.verify_checkpoints,
             log=lambda s, m: self.logger.log("warn", s, message=m),
+            info_log=lambda s, m: self.logger.log("info", s, message=m),
             injector=self._inj,
-            config_digest=config_digest(dataclasses.asdict(cfg)))
+            config_digest=config_digest(dataclasses.asdict(digest_src)),
+            writer=ckpt_writer)
         # VGG16 pretrained conv-trunk init (`flyingChairsTrain.py:60-76`);
         # fresh starts only — a checkpoint to resume from takes precedence.
         _vgg_trunks = {"vgg16": ("encoder",), "st_single": ("encoder",),
@@ -334,7 +365,22 @@ class Trainer:
         cfg = self.cfg
         self.enable_augmentation()
         start_step = int(self.state.step)
-        seed_arr = data_stream_seed(self.mesh, cfg.train.seed, start_step)
+        el = cfg.elastic
+        if self._elastic_child:
+            # Elastic determinism contract (train/elastic.py): the host
+            # index, the CURRENT world size, and the generation are all
+            # folded into the base seed — each re-form re-shards every
+            # survivor onto a stream decorrelated from everything any
+            # previous generation drew, and the whole run reproduces
+            # from (seed, fault schedule) alone.
+            from ..parallel.mesh import elastic_stream_seed
+
+            seed_arr = elastic_stream_seed(cfg.train.seed, el.host_index,
+                                           el.num_hosts, el.generation,
+                                           start_step)
+        else:
+            seed_arr = data_stream_seed(self.mesh, cfg.train.seed,
+                                        start_step)
         inj = self._inj
         # Self-healing data path (resilience/healing.py): per micro-batch
         # index, bounded retries with backoff — the rng is RE-DERIVED per
@@ -560,6 +606,11 @@ class Trainer:
             total_steps = (num_epochs or cfg.train.num_epochs) * self.steps_per_epoch
             if max_steps is not None:
                 total_steps = min(total_steps, start_step + max_steps)
+            if self._elastic_child and el.target_step > 0:
+                # elastic runs train to an ABSOLUTE step: a respawned
+                # trainer resumes from the re-form checkpoint and stops
+                # where the run ends, not target-more-steps later
+                total_steps = int(el.target_step)
             if cfg.train.nan_guard and self.ckpt.latest_step() is None:
                 self.ckpt.save(self.state)  # rollback target before step 1
             ckpt_mark = timer.mark()
@@ -646,7 +697,34 @@ class Trainer:
             gstep = start_step
             consecutive_nans = 0
             metrics = None
+            # Pacing floor cache: the floor only advances within a
+            # generation, so while gstep stays within sync_ahead of the
+            # last observed floor no file read is needed at all.
+            world_floor = start_step
             while gstep < total_steps and stop_sig["sig"] is None:
+                if (self._elastic_child and el.sync_ahead > 0
+                        and el.world_file
+                        and gstep - world_floor > el.sync_ahead):
+                    # step-skew limiter (train/elastic.py): wait while
+                    # this host is more than sync_ahead steps past the
+                    # slowest live host — a re-form can then discard at
+                    # most ckpt-cadence + sync_ahead steps. The wait
+                    # touches the heartbeat: a pacing leader is healthy.
+                    floor = pace_to_world(
+                        el.world_file, el.generation, gstep,
+                        el.sync_ahead,
+                        should_stop=lambda: stop_sig["sig"] is not None,
+                        touch=(heartbeat.touch if heartbeat is not None
+                               else None),
+                        # a coordinator dead long enough to look stale
+                        # by its own verdict horizon has stopped
+                        # publishing: finish as an orphan, don't block
+                        stale_s=max(3 * el.poll_s, el.stale_after_s))
+                    # inapplicable pacing (no file / stale generation)
+                    # re-checks only after another sync_ahead steps
+                    world_floor = floor if floor is not None else gstep
+                    if stop_sig["sig"] is not None:
+                        break
                 self.profiler.observe(gstep, k)  # --profile-steps window
                 t0 = time.perf_counter()
                 with obs_trace.span("input_wait"):
@@ -710,6 +788,17 @@ class Trainer:
                 cur_step["s"] = gstep  # live step for healer warn records
                 if heartbeat is not None:
                     heartbeat.beat(gstep)
+                if inj is not None and self._elastic_child:
+                    # host-level chaos (train/elastic.py): SIGKILL /
+                    # wedge / preemption-SIGTERM of THIS host once its
+                    # step reaches faults.host_fault_step — after the
+                    # beat, so the coordinator's last observation of a
+                    # killed host is the step it actually completed
+                    maybe_host_fault(
+                        inj, el.host_index, gstep,
+                        cfg.resilience.faults.host_fault_step,
+                        log=lambda m: self.logger.log(
+                            "warn", gstep, message=m))
                 epoch = gstep // self.steps_per_epoch
                 end_of_epoch = _crossed(prev, gstep, self.steps_per_epoch)
                 log_due = _crossed(prev, gstep, cfg.train.log_every) or end_of_epoch

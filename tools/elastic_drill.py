@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Headless elastic-training chaos drill (DESIGN.md "Elastic training").
+
+Runs a small N-virtual-host elastic training run (`deepof_tpu train
+--elastic N`) with a seeded `host_loss` SIGKILL of one host mid-run —
+the production preemption scenario, end to end, on one machine — and
+emits a pinned-schema JSON verdict: did the run complete to the target
+step with zero operator action, how many re-forms it took, how much
+work was lost, and how long recovery took (loss detection -> every
+survivor training again).
+
+This is the CI-shaped face of the acceptance drill in
+tests/test_elastic.py (slow tier) and the source of the elastic rows in
+the BENCH_r0x.json cpu proxies:
+
+    python tools/elastic_drill.py --hosts 3 --target 10 \
+        --kill-host 1 --kill-step 4
+
+Exit code 0 iff the drill completed (target reached, checkpoints
+verify); 1 otherwise. `--fault none` runs the fault-free control (the
+supervision layer must never misjudge a healthy host: reforms == 0).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Pinned output schema — downstream tooling (BENCH recorders, CI
+#: gates) may rely on exactly these keys existing.
+REQUIRED_KEYS = (
+    "hosts", "target_step", "fault", "completed", "rc",
+    "generation", "reforms", "lost_hosts", "steps_lost", "resumed_step",
+    "max_step", "recovery_wall_s", "wall_s", "ckpt_ok", "tail_rc",
+)
+
+
+def run_drill(hosts: int = 3, target: int = 10, kill_host: int = 1,
+              kill_step: int = 4, ckpt_every: int = 3,
+              fault: str = "host_loss", log_dir: str | None = None,
+              timeout_s: float = 900.0) -> dict:
+    """One drill run; returns the REQUIRED_KEYS dict."""
+    own_dir = log_dir is None
+    if own_dir:
+        log_dir = tempfile.mkdtemp(prefix="elastic_drill_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    cmd = [sys.executable, "-m", "deepof_tpu", "train", "--preset",
+           "flyingchairs", "--synthetic", "--elastic", str(hosts),
+           "--max-steps", str(target), "--log-dir", log_dir,
+           "--set", "model=flownet_s", "--set", "width_mult=0.25",
+           "--set", "data.batch_size=4", "--set", "train.eval_batch_size=4",
+           "--set", "train.log_every=1", "--set", "train.eval_every=0",
+           "--set", "train.ckpt_every_epochs=1000000",
+           "--set", f"train.ckpt_every_steps={ckpt_every}",
+           "--set", "obs.heartbeat_period_s=0.25",
+           "--set", "elastic.poll_s=0.2",
+           "--set", "elastic.stale_after_s=10",
+           "--set", "elastic.wedge_after_s=30",
+           # skew limiter <= ckpt cadence so the re-form's discarded
+           # tail stays within the checkpoint period by construction
+           "--set", f"elastic.sync_ahead={max(min(ckpt_every - 1, 4), 1)}"]
+    if fault != "none":
+        cmd += ["--set", "resilience.faults.enabled=true",
+                "--set", f"resilience.faults.{fault}_at=({kill_host},)",
+                "--set", f"resilience.faults.host_fault_step={kill_step}"]
+    t0 = time.monotonic()
+    res = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout_s, env=env, cwd=REPO)
+    wall = time.monotonic() - t0
+    lines = [ln for ln in res.stdout.strip().splitlines() if ln]
+    try:
+        summary = json.loads(lines[-1]) if lines else {}
+    except json.JSONDecodeError:
+        summary = {}
+
+    from deepof_tpu.resilience import verify as ckpt_verify
+
+    rep = ckpt_verify.verify_run(log_dir)
+    # success demands a manifest-VERIFIED checkpoint at/past the target
+    # (a torn, manifest-less final save must not pass the drill)
+    ckpt_ok = bool(rep["ok"]) and (max(rep["valid_steps"],
+                                       default=0) >= target)
+    tail = subprocess.run(
+        [sys.executable, "-m", "deepof_tpu", "tail", "--log-dir", log_dir],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    out = {
+        "hosts": hosts,
+        "target_step": target,
+        "fault": fault,
+        "completed": bool(summary.get("completed"))
+        and res.returncode == 0 and ckpt_ok,
+        "rc": res.returncode,
+        "generation": summary.get("elastic_generation"),
+        "reforms": summary.get("elastic_reforms"),
+        "lost_hosts": summary.get("elastic_lost_hosts"),
+        "steps_lost": summary.get("elastic_steps_lost"),
+        "resumed_step": summary.get("elastic_resumed_step"),
+        "max_step": summary.get("elastic_max_step"),
+        # loss detection -> every survivor running again (the
+        # coordinator stamps it when the re-formed world is back)
+        "recovery_wall_s": summary.get("elastic_last_reform_s"),
+        "wall_s": round(wall, 2),
+        "ckpt_ok": ckpt_ok,
+        "tail_rc": tail.returncode,
+        "log_dir": log_dir,
+    }
+    if res.returncode != 0:
+        out["stderr_tail"] = res.stderr[-1500:]
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--hosts", type=int, default=3)
+    ap.add_argument("--target", type=int, default=10,
+                    help="absolute target step")
+    ap.add_argument("--kill-host", type=int, default=1)
+    ap.add_argument("--kill-step", type=int, default=4,
+                    help="arm the fault at this global step")
+    ap.add_argument("--ckpt-every", type=int, default=3,
+                    help="checkpoint cadence (bounds lost work)")
+    ap.add_argument("--fault", default="host_loss",
+                    choices=("host_loss", "host_wedge", "preempt_notice",
+                             "none"),
+                    help="which host chaos site to arm (none = "
+                         "fault-free control: reforms must be 0)")
+    ap.add_argument("--log-dir", default=None,
+                    help="run directory (default: a fresh temp dir)")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args(argv)
+
+    out = run_drill(hosts=args.hosts, target=args.target,
+                    kill_host=args.kill_host, kill_step=args.kill_step,
+                    ckpt_every=args.ckpt_every, fault=args.fault,
+                    log_dir=args.log_dir, timeout_s=args.timeout)
+    missing = [k for k in REQUIRED_KEYS if k not in out]
+    assert not missing, f"drill output missing pinned keys: {missing}"
+    print(json.dumps(out, indent=2))
+    return 0 if out["completed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
