@@ -221,8 +221,100 @@ def load_heartbeat(log_dir: str) -> dict | None:
         return None
 
 
+# ----------------------------------------------- multi-process run dirs
+
+
+def discover_process_dirs(log_dir: str) -> dict[str, str]:
+    """{child name -> dir} for a supervised run's per-process subdirs
+    (fleet replicas / elastic trainer hosts) that actually hold
+    observability artifacts. Empty for a plain single-process run.
+    Delegates to obs/aggregate.py's walker — ONE definition of "a child
+    process dir", shared with `trace_summary --merge`, so the two views
+    can never disagree about which processes a drill contains."""
+    from .obs.aggregate import discover_processes  # stdlib-only chain
+
+    out: dict[str, str] = {}
+    for p in discover_processes(log_dir):
+        if not p["rel"]:
+            continue  # the supervisor itself: the caller's own summary
+        out[p["rel"].replace(os.sep, "/")] = p["dir"]
+    return out
+
+
+def _process_summary(d: str, now: float) -> dict:
+    """One child process's condensed health block: record counts, the
+    live heartbeat verdict, and whichever counter blocks (serve / fleet
+    / elastic / resilience) the process emits."""
+    out: dict = {}
+    try:
+        records = load_records(d)
+    except FileNotFoundError:
+        records = []
+    out["records"] = len(records)
+    hb = load_heartbeat(d)
+    newest: dict = {}
+    for kind in ("serve", "elastic"):
+        kinds = [r for r in records if r.get("kind") == kind]
+        if kinds:
+            newest.update(kinds[-1])
+    if hb is not None:
+        newest.update(hb)  # fresher than any record, wins per key
+        out["step"] = hb.get("step")
+        out["wedged"] = hb.get("wedged")
+        t = hb.get("time")
+        if isinstance(t, (int, float)):
+            out["heartbeat_age_s"] = round(now - t, 1)
+    for name, extract in (("serve", _serve_counters),
+                          ("fleet", _fleet_counters),
+                          ("elastic", _elastic_counters)):
+        block = extract(newest)
+        if block:
+            out[name] = block
+    res = _resilience_counters(newest)
+    if res:
+        out["resilience"] = res
+    warns = [r for r in records if r.get("kind") == "warn"]
+    if warns:
+        out["warnings"] = len(warns)
+    return out
+
+
+def aggregate_processes(log_dir: str, now: float | None = None) -> dict | None:
+    """The whole-drill view of a multi-process run dir: one condensed
+    block per child (replica-N / host-N) plus a `merged` block — summed
+    serve counters and the EXACT fixed-bucket latency-histogram merge
+    (obs/export.py) across every child that reports one. None when the
+    dir has no supervised children (plain run)."""
+    dirs = discover_process_dirs(log_dir)
+    if not dirs:
+        return None
+    now = time.time() if now is None else now
+    children = {name: _process_summary(d, now) for name, d in dirs.items()}
+    merged: dict = {}
+    hists = []
+    for child in children.values():
+        serve = child.get("serve") or {}
+        for k in ("requests", "responses", "errors", "batches"):
+            if isinstance(serve.get(k), (int, float)):
+                merged[k] = merged.get(k, 0) + serve[k]
+        hist = serve.get("latency_hist")
+        if hist:
+            hists.append(hist)
+    if hists:
+        from .obs.export import merge_hists  # stdlib-only import chain
+
+        try:
+            merged["latency_hist"] = merge_hists(hists)
+        except ValueError:
+            pass  # foreign/old-format snapshot: skip, never crash tail
+    out = {"processes": children}
+    if merged:
+        out["merged"] = merged
+    return out
+
+
 def tail_summary(log_dir: str, recent: int = 10,
-                 now: float | None = None) -> dict:
+                 now: float | None = None, fleet: bool = False) -> dict:
     """One-glance health of a LIVE or finished run (`deepof_tpu tail`):
     where it is, whether it is moving, how fast recently vs overall,
     where host time goes, and how stale the heartbeat is.
@@ -232,6 +324,9 @@ def tail_summary(log_dir: str, recent: int = 10,
     rate is recomputed from the newest records' (step, time) gaps —
     median of per-gap slopes, robust to one eval/ckpt pause inside the
     window — the number that answers "is it slowing down?".
+    fleet: also aggregate the run dir's supervised children (fleet
+    replicas / elastic hosts) into a `processes` + `merged` block
+    (`tail --fleet`) — the whole drill in one read.
     """
     records = load_records(log_dir)
     now = time.time() if now is None else now
@@ -317,9 +412,10 @@ def tail_summary(log_dir: str, recent: int = 10,
         # a fleet supervisor's heartbeat carries the live fleet_* block
         # (replica states, evictions/respawns/broken, failovers, shed) —
         # `tail` exits 4 when it shows evictions or a broken replica
-        fleet = _fleet_counters(hb)
-        if fleet:
-            out["fleet"] = fleet
+        # (fleet_block, not fleet: the parameter must stay visible)
+        fleet_block = _fleet_counters(hb)
+        if fleet_block:
+            out["fleet"] = fleet_block
         # an elastic coordinator's heartbeat carries the live elastic_*
         # block (generation, re-forms, lost hosts, steps lost, per-host
         # states) — `tail` exits 5 when the run had to re-form
@@ -334,15 +430,19 @@ def tail_summary(log_dir: str, recent: int = 10,
             if serve:
                 out["serve"] = serve
         if "fleet" not in out:
-            fleet = _fleet_counters(serves[-1])
-            if fleet:
-                out["fleet"] = fleet
+            fleet_block = _fleet_counters(serves[-1])
+            if fleet_block:
+                out["fleet"] = fleet_block
     if "elastic" not in out:
         elastics = [r for r in records if r.get("kind") == "elastic"]
         if elastics:
             elastic = _elastic_counters(elastics[-1])
             if elastic:
                 out["elastic"] = elastic
+    if fleet:
+        agg = aggregate_processes(log_dir, now=now)
+        if agg:
+            out.update(agg)
     return out
 
 
@@ -382,6 +482,11 @@ def plot_curves(records: list[dict], out_dir: str) -> list[str]:
 def analyze(log_dir: str, plot: bool = True) -> dict:
     records = load_records(log_dir)
     summary = summarize(records)
+    # a supervised run dir (fleet replicas / elastic hosts) aggregates
+    # its children too: one `analyze` summarizes the whole drill
+    agg = aggregate_processes(log_dir)
+    if agg:
+        summary.update(agg)
     if plot:
         summary["plots"] = plot_curves(records, log_dir)
     return summary

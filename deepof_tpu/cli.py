@@ -254,10 +254,18 @@ def main(argv=None) -> int:
                      "starvation, resilience counters, heartbeat age; "
                      "exits 3 when the heartbeat reports wedged, 4 when "
                      "a serving fleet evicted or broke a replica, 5 "
-                     "when an elastic run lost a host and re-formed")
+                     "when an elastic run lost a host and re-formed, 6 "
+                     "when the SLO error budget is exhausted "
+                     "(obs.slo_latency_ms / obs.slo_error_budget)")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
+    p_tail.add_argument("--fleet", action="store_true",
+                        help="also aggregate the run dir's supervised "
+                             "children (fleet replicas / elastic hosts) "
+                             "into per-process blocks + an exact merged "
+                             "latency histogram — the whole drill in one "
+                             "read")
     p_tail.add_argument("--follow", action="store_true",
                         help="re-print every --interval seconds until ^C")
     p_tail.add_argument("--interval", type=float, default=10.0)
@@ -287,7 +295,8 @@ def main(argv=None) -> int:
 
         while True:
             try:
-                summary = tail_summary(args.log_dir, recent=args.recent)
+                summary = tail_summary(args.log_dir, recent=args.recent,
+                                       fleet=args.fleet)
             except FileNotFoundError:
                 raise SystemExit(f"no metrics.jsonl under {args.log_dir!r} "
                                  "— is this a run's --log-dir?")
@@ -313,6 +322,15 @@ def main(argv=None) -> int:
             elastic = summary.get("elastic") or {}
             if elastic.get("reforms") or elastic.get("lost_hosts"):
                 return 5
+            # rc 6 when the SLO error budget is exhausted (the serve
+            # engine's serve_slo or the fleet router's fleet_slo block,
+            # obs/export.py): latency breaches + server-side failures
+            # overran obs.slo_error_budget — the run may still be
+            # serving, but it is OUTSIDE its contract
+            slo = ((summary.get("serve") or {}).get("slo")
+                   or (summary.get("fleet") or {}).get("slo") or {})
+            if slo.get("exhausted"):
+                return 6
             if not args.follow:
                 return 0
             import time as _time

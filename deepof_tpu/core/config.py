@@ -276,6 +276,25 @@ class ObsConfig:
     # compile): every periodic train record then carries model_tflops +
     # nominal MFU — the bench-only telemetry, promoted into training.
     flops: bool = True
+    # --- Fleet observability plane (obs/export.py + obs/aggregate.py,
+    # DESIGN.md "Fleet observability") ---
+    # SLO latency target in ms: requests slower than this (rounded UP to
+    # the nearest fixed histogram bucket bound — the bucket contract
+    # that makes burn identical at every aggregation level) breach the
+    # SLO, and breaches + server-side failures burn the error budget.
+    # The serve engine reports `serve_slo`, the fleet router
+    # `fleet_slo` (on /healthz, /metrics, heartbeat, and `tail`, which
+    # exits 6 when the budget is exhausted). 0 disables the SLO layer.
+    slo_latency_ms: float = 0.0
+    # Allowed bad fraction (latency breaches + failures over admitted
+    # requests); burn = bad_fraction / budget, exhausted at burn >= 1.
+    slo_error_budget: float = 0.01
+    # Standalone GET /metrics + /healthz endpoint for processes without
+    # an HTTP frontend of their own (the elastic coordinator binds one
+    # when set; the serve server and fleet router mount /metrics on
+    # their existing ports instead). None = off; 0 = ephemeral port
+    # (announced on stdout); > 0 = that port.
+    metrics_port: int | None = None
 
 
 @dataclass(frozen=True)

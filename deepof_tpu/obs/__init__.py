@@ -18,11 +18,23 @@ holds the instruments that can:
   telemetry.py  process/device sampling shared by training and bench:
                 XLA cost-analysis FLOPs (model TFLOP/s + nominal MFU),
                 per-device memory_stats, process RSS.
+  export.py     the scrapeable face (DESIGN.md "Fleet observability"):
+                fixed log-spaced latency histograms that merge EXACTLY
+                across processes, Prometheus text rendering/parsing for
+                every stats block (GET /metrics on the serve server,
+                the fleet router, the elastic coordinator), and the
+                latency/error-budget SLO layer (`tail` rc 6).
+  aggregate.py  multi-process trace merge: every per-process
+                trace.json/heartbeat.json/metrics.jsonl under a run dir
+                becomes ONE Perfetto timeline with per-process tracks
+                and request-id flow arrows chaining each request across
+                router and replica (`tools/trace_summary.py --merge`).
 
-Import discipline: this __init__ and trace.py import only the stdlib
-(`bench.py`'s orchestrating parent and `analyze.py` may import them
-without initializing an accelerator backend); telemetry.py defers its
-jax imports into the sampling functions for the same reason.
+Import discipline: this __init__, trace.py, export.py, and aggregate.py
+import only the stdlib (`bench.py`'s orchestrating parent and
+`analyze.py` may import them without initializing an accelerator
+backend); telemetry.py defers its jax imports into the sampling
+functions for the same reason.
 """
 
 from . import trace

@@ -1,0 +1,347 @@
+"""Scrapeable metrics: fixed latency histograms, Prometheus text
+rendering, and the SLO layer (DESIGN.md "Fleet observability").
+
+Every stats surface in this repo (`serve_*` engine counters, `fleet_*`
+router/supervisor counters, `elastic_*` coordinator counters, resilience
+counters) is a flat dict of numbers that previously lived only in
+heartbeat.json and metrics.jsonl. This module makes those same blocks
+scrapeable:
+
+  LatencyHistogram — FIXED log-spaced buckets (`LATENCY_BUCKETS_MS`,
+      powers of two from 0.5 ms to ~16 s). Fixed by contract: two
+      processes' histograms merge EXACTLY (bucket-wise integer sum), so
+      the router's fleet-wide histogram equals the sum of its replicas'
+      and a percentile read upstream never disagrees with one taken
+      downstream. Thread-safe, O(1) observe.
+
+  render_prometheus / parse_prometheus — the Prometheus text exposition
+      format (text/plain; version=0.0.4) over any stats dict: numbers
+      become gauges, nested numeric maps become labeled gauges, nested
+      string maps become `{key=...,value=...} 1` state samples, and
+      histogram snapshots become `_bucket{le=...}` cumulative series +
+      `_sum`/`_count`. The parser is the test suite's and the bench
+      recorder's read-back path, so render/parse round-trip is pinned.
+
+  slo_state — latency/error-budget arithmetic from a histogram snapshot:
+      the SLO threshold rounds UP to the nearest histogram bound (the
+      bucket contract again — burn computed at any aggregation level is
+      identical), breaches + server-side failures burn the error budget,
+      and `exhausted` is the bit `tail` turns into its distinct exit
+      code.
+
+  start_metrics_server — a minimal stdlib HTTP server (GET /metrics +
+      GET /healthz) for processes that have no HTTP frontend of their
+      own (the elastic coordinator); the serve server and the fleet
+      router mount /metrics on their existing handlers instead.
+
+Stdlib-only at import (obs/__init__ discipline): analyze/tail and the
+jax-free supervisors all use this module.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from bisect import bisect_left
+from typing import Callable
+
+#: Fixed log-spaced latency bucket upper bounds, in milliseconds
+#: (powers of two, 0.5 ms .. 16.4 s; one implicit +Inf bucket past the
+#: end). FIXED means: never derived from config or observed data — two
+#: histograms anywhere in the fleet always share these bounds, so
+#: merging is an exact bucket-wise sum.
+LATENCY_BUCKETS_MS: tuple[float, ...] = tuple(0.5 * 2 ** i
+                                              for i in range(16))
+
+
+class LatencyHistogram:
+    """Thread-safe fixed-bucket latency histogram (see module docstring).
+
+    `observe` takes seconds (every latency in this repo is monotonic
+    seconds); the snapshot reports milliseconds (the unit the serve
+    percentiles already use)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)  # last = +Inf
+        self._sum_ms = 0.0
+        self._count = 0
+
+    def observe(self, seconds: float) -> None:
+        ms = max(float(seconds), 0.0) * 1e3
+        idx = bisect_left(LATENCY_BUCKETS_MS, ms)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum_ms += ms
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """JSON-ready state: {"buckets_ms", "counts", "sum_ms",
+        "count"}. `counts` are per-bucket (NOT cumulative) so snapshots
+        merge by element-wise addition; the Prometheus renderer
+        cumulates at render time."""
+        with self._lock:
+            return {"buckets_ms": list(LATENCY_BUCKETS_MS),
+                    "counts": list(self._counts),
+                    "sum_ms": round(self._sum_ms, 3),
+                    "count": self._count}
+
+
+def is_hist_snapshot(value) -> bool:
+    return (isinstance(value, dict) and "counts" in value
+            and "buckets_ms" in value)
+
+
+def merge_hists(snapshots: list[dict]) -> dict:
+    """Element-wise EXACT merge of histogram snapshots — the fleet
+    aggregation primitive. Raises ValueError on a bucket-bound mismatch
+    (a foreign histogram must fail loudly, not merge approximately)."""
+    buckets = list(LATENCY_BUCKETS_MS)
+    counts = [0] * (len(buckets) + 1)
+    sum_ms = 0.0
+    count = 0
+    for s in snapshots:
+        if not is_hist_snapshot(s):
+            raise ValueError(f"not a histogram snapshot: {s!r}")
+        if list(s["buckets_ms"]) != buckets or len(s["counts"]) != len(counts):
+            raise ValueError(
+                "histogram bucket bounds differ — cannot merge exactly "
+                f"(got {s['buckets_ms']!r})")
+        for i, c in enumerate(s["counts"]):
+            counts[i] += int(c)
+        sum_ms += float(s["sum_ms"])
+        count += int(s["count"])
+    return {"buckets_ms": buckets, "counts": counts,
+            "sum_ms": round(sum_ms, 3), "count": count}
+
+
+# ------------------------------------------------------------------ SLO
+
+
+def validate_slo(obs_cfg) -> None:
+    """Loud config validation (the config_from_dict philosophy: a knob
+    that cannot work must fail at construction, not silently no-op).
+    A latency target past the largest histogram bound could never count
+    a breach — the fixed buckets cannot distinguish 17 s from 60 s —
+    so the serve engine and the fleet router reject it up front."""
+    target = float(obs_cfg.slo_latency_ms)
+    if target > LATENCY_BUCKETS_MS[-1]:
+        raise ValueError(
+            f"obs.slo_latency_ms={target:g} exceeds the largest fixed "
+            f"histogram bound ({LATENCY_BUCKETS_MS[-1]:g} ms) — breaches "
+            "past it are indistinguishable in the bucket layout and the "
+            "SLO would silently never burn; pick a target <= the bound "
+            "(or 0 to disable the SLO layer)")
+    if float(obs_cfg.slo_error_budget) <= 0:
+        raise ValueError(
+            f"obs.slo_error_budget={obs_cfg.slo_error_budget!r} must be "
+            "> 0 (the fraction of requests allowed to breach)")
+
+
+def slo_state(hist: dict | None, requests: int, failures: int,
+              latency_ms: float, error_budget: float) -> dict:
+    """Latency/error-budget state from one histogram snapshot.
+
+    hist: a LatencyHistogram snapshot (None = no latency data yet).
+    requests: total admitted requests (the budget's denominator).
+    failures: server-side failures (shed/unavailable/dispatch — CLIENT
+        errors deliberately excluded: a caller's bad input must not burn
+        the operator's budget).
+    latency_ms: the SLO latency target; rounded UP to the nearest
+        histogram bucket bound ("bucket_ms" reports the effective
+        threshold) so burn computed from merged histograms at any
+        aggregation level is identical.
+    error_budget: allowed bad fraction (breaches + failures over
+        requests); burn = bad_fraction / budget, exhausted at >= 1.
+    """
+    latency_ms = float(latency_ms)
+    idx = bisect_left(LATENCY_BUCKETS_MS, latency_ms)
+    bucket_ms = (LATENCY_BUCKETS_MS[idx] if idx < len(LATENCY_BUCKETS_MS)
+                 else None)  # None: the target exceeds every bound (+Inf)
+    breaches = 0
+    if is_hist_snapshot(hist):
+        # observations STRICTLY above the effective bound: everything in
+        # buckets past idx (bucket idx holds obs <= its bound)
+        breaches = sum(int(c) for c in hist["counts"][idx + 1:])
+    requests = max(int(requests), 0)
+    failures = max(int(failures), 0)
+    bad = breaches + failures
+    budget = max(float(error_budget), 1e-9)
+    bad_fraction = (bad / requests) if requests else 0.0
+    burn = bad_fraction / budget
+    return {
+        "latency_ms": latency_ms,
+        "bucket_ms": bucket_ms,
+        "error_budget": round(budget, 6),
+        "requests": requests,
+        "breaches": breaches,
+        "failures": failures,
+        "bad_fraction": round(bad_fraction, 6),
+        "burn": round(burn, 4),
+        "exhausted": bool(requests and bad_fraction >= budget),
+    }
+
+
+# ----------------------------------------------------------- prometheus
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+#: /metrics Content-Type (the exposition-format version Prometheus pins)
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    return f"_{name}" if name[:1].isdigit() else name
+
+
+def _escape_label(value) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    f = float(value)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(stats: dict, namespace: str = "deepof") -> str:
+    """Render a flat stats dict (the serve_*/fleet_*/elastic_* blocks)
+    as Prometheus text exposition format. Rules:
+
+      number/bool          -> gauge `ns_key value`
+      dict of numbers      -> labeled gauge `ns_key{key="sub"} value`
+      dict of strings      -> state sample `ns_key{key="sub",value="s"} 1`
+      histogram snapshot   -> `ns_base_bucket{le=...}` CUMULATIVE counts
+                              (+Inf last) + `ns_base_sum` + `ns_base_count`,
+                              where base strips a trailing `_hist` and
+                              appends `_ms` (the unit of the bounds)
+      None / other         -> skipped
+
+    Deterministic output ordering (sorted keys) so scrapes diff cleanly.
+    """
+    lines: list[str] = []
+    for key in sorted(stats):
+        value = stats[key]
+        if value is None or isinstance(value, str):
+            continue
+        name = f"{_sanitize(namespace)}_{_sanitize(key)}"
+        if is_hist_snapshot(value):
+            base = key[:-len("_hist")] if key.endswith("_hist") else key
+            base = f"{_sanitize(namespace)}_{_sanitize(base)}_ms"
+            lines.append(f"# TYPE {base} histogram")
+            cum = 0
+            for bound, c in zip(value["buckets_ms"], value["counts"]):
+                cum += int(c)
+                lines.append(f'{base}_bucket{{le="{_fmt(bound)}"}} {cum}')
+            cum += int(value["counts"][len(value["buckets_ms"])])
+            lines.append(f'{base}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{base}_sum {_fmt(value['sum_ms'])}")
+            lines.append(f"{base}_count {_fmt(value['count'])}")
+        elif isinstance(value, dict):
+            numeric = {k: v for k, v in value.items()
+                       if isinstance(v, (int, float)) and v is not None}
+            stringy = {k: v for k, v in value.items() if isinstance(v, str)}
+            if numeric:
+                lines.append(f"# TYPE {name} gauge")
+                for sub in sorted(numeric):
+                    lines.append(
+                        f'{name}{{key="{_escape_label(sub)}"}} '
+                        f"{_fmt(numeric[sub])}")
+            if stringy:
+                lines.append(f"# TYPE {name} gauge")
+                for sub in sorted(stringy):
+                    lines.append(
+                        f'{name}{{key="{_escape_label(sub)}",'
+                        f'value="{_escape_label(stringy[sub])}"}} 1')
+        elif isinstance(value, (int, float)):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Inverse of render_prometheus for the test suite and the bench
+    scrape path: {"name" or 'name{a="b",...}' (labels sorted): value}.
+    Unparseable lines are skipped (a scrape must not crash the reader)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labels, raw = m.groups()
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        if labels:
+            pairs = sorted(
+                (k, v.encode().decode("unicode_escape"))
+                for k, v in _LABEL_RE.findall(labels))
+            name += "{" + ",".join(f'{k}="{v}"' for k, v in pairs) + "}"
+        out[name] = value
+    return out
+
+
+# -------------------------------------------------------- metrics server
+
+
+def start_metrics_server(stats_fn: Callable[[], dict],
+                         host: str = "127.0.0.1", port: int = 0):
+    """A minimal daemon-threaded HTTP server exposing GET /metrics
+    (Prometheus text over `stats_fn()`) and GET /healthz (the same dict
+    as JSON) — for processes with no frontend of their own (the elastic
+    coordinator). Returns the already-serving HTTPServer; callers read
+    `server_address` for the bound port and call shutdown()/
+    server_close() on exit. `stats_fn` failures become a 500, never a
+    crashed serving thread."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # obs owns visibility
+            pass
+
+        def _reply(self, status: int, body: bytes, ctype: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+            if self.path not in ("/metrics", "/healthz", "/stats"):
+                self._reply(404, b'{"error": "not_found"}',
+                            "application/json")
+                return
+            try:
+                stats = stats_fn() or {}
+            except Exception as e:  # noqa: BLE001 - scrape must not kill
+                self._reply(500, json.dumps(
+                    {"error": "stats_failed",
+                     "message": f"{type(e).__name__}: {e}"}).encode(),
+                    "application/json")
+                return
+            if self.path == "/metrics":
+                self._reply(200, render_prometheus(stats).encode(),
+                            PROM_CONTENT_TYPE)
+            else:
+                self._reply(200, json.dumps(stats).encode(),
+                            "application/json")
+
+    httpd = Server((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="obs-metrics").start()
+    return httpd

@@ -33,9 +33,20 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
+
+#: CPython's auto-generated thread names ("Thread-12 (handler_func)"):
+#: ThreadingHTTPServer spawns one uniquely-auto-named thread per HTTP
+#: request, and keying tracks by (tid, emit-time name) would otherwise
+#: mint one single-span track per REQUEST once idents recycle. The
+#: serial number carries no identity — collapse it so every
+#: auto-named thread running the same function shares one track name,
+#: while explicitly-named threads (prefetch, serve-batcher,
+#: pipeline-worker-N, ...) keep the full recycle-split fix.
+_AUTO_THREAD_NAME = re.compile(r"^Thread-\d+( \(.*\))?$")
 
 
 class _NullSpan:
@@ -49,6 +60,9 @@ class _NullSpan:
 
     def __exit__(self, *exc) -> bool:
         return False
+
+    def set(self, **args) -> None:
+        """No-op counterpart of _Span.set."""
 
 
 _NULL_SPAN = _NullSpan()
@@ -83,6 +97,13 @@ class _Span:
         self._t0 = time.perf_counter()
         return self
 
+    def set(self, **args) -> None:
+        """Attach args discovered DURING the span (e.g. the batcher
+        learns its request ids only while accumulating the batch)."""
+        if self._args is None:
+            self._args = {}
+        self._args.update(args)
+
     def __exit__(self, *exc) -> bool:
         self._tracer._record(self._name, self._t0, time.perf_counter(),
                              self._args)
@@ -96,17 +117,28 @@ class Tracer:
         `<log_dir>/trace.json`).
     ring_size: max retained events — spans beyond it evict the oldest
         (bounded memory; a full training run keeps its newest window).
+    role / index: process identity stamped into the trace (process_name
+        metadata + otherData) so obs/aggregate.py can merge many
+        processes' traces into one fleet timeline — "trainer-1",
+        "replica-0", "router", "coordinator".
     """
 
-    def __init__(self, path: str | None = None, ring_size: int = 16384):
+    def __init__(self, path: str | None = None, ring_size: int = 16384,
+                 role: str | None = None, index: int | None = None):
         self.path = path
         self.ring_size = max(int(ring_size), 16)
+        self.role = role
+        self.index = index
         self._events: deque = deque(maxlen=self.ring_size)
         self._epoch = time.perf_counter()
         self._epoch_unix = time.time()
-        # tid -> thread name, captured at first event from that thread.
-        # Plain dict: item assignment is GIL-atomic, and a benign
-        # double-write of the same name is harmless.
+        # tid -> thread name registry (historical record: a thread whose
+        # every event was evicted from the ring is still named in the
+        # metadata). NOT the source of truth for event->name binding —
+        # each event records its thread's name at EMIT time, so a tid
+        # the OS recycled onto a later, differently-named thread cannot
+        # retroactively rename earlier spans (the PR 3 last-writer-wins
+        # hazard); events() splits such a tid into per-name tracks.
         self._threads: dict[int, str] = {}
         self._dropped = 0  # informational; deque eviction is implicit
 
@@ -117,52 +149,94 @@ class Tracer:
     def instant(self, name: str, **args) -> None:
         """A zero-duration marker (ph='i') — e.g. the watchdog's wedge."""
         now = time.perf_counter()
-        self._note_thread()
-        self._events.append(("i", name, threading.get_ident(),
+        tname = self._note_thread()
+        self._events.append(("i", name, threading.get_ident(), tname,
                              (now - self._epoch) * 1e6, 0.0, args or None))
 
-    def _note_thread(self) -> None:
-        # unconditional (last-writer-wins) setitem: one GIL-atomic dict
-        # op, and an ident REUSED by a later thread maps to the name of
-        # the thread that most recently emitted under it (the OS may
-        # recycle idents of finished threads; Chrome's tid-keyed format
-        # cannot distinguish them anyway)
-        self._threads[threading.get_ident()] = threading.current_thread().name
+    def _note_thread(self) -> str:
+        # the registry write is one GIL-atomic dict op; the RETURNED
+        # name is what binds the event (emit-time capture — see __init__)
+        name = threading.current_thread().name
+        m = _AUTO_THREAD_NAME.match(name)
+        if m:  # auto-named ephemeral: drop the per-thread serial
+            name = "Thread" + (m.group(1) or "")
+        self._threads[threading.get_ident()] = name
+        return name
 
     def _record(self, name: str, t0: float, t1: float,
                 args: dict | None) -> None:
-        self._note_thread()
+        tname = self._note_thread()
         if len(self._events) == self.ring_size:
             self._dropped += 1  # append below evicts the oldest
-        self._events.append(("X", name, threading.get_ident(),
+        self._events.append(("X", name, threading.get_ident(), tname,
                              (t0 - self._epoch) * 1e6, (t1 - t0) * 1e6,
                              args))
 
     # ------------------------------------------------------------- flush
+    def process_name(self) -> str:
+        """The track label for this process in a merged fleet trace."""
+        if self.role is None:
+            return "deepof_tpu"
+        return (self.role if self.index is None
+                else f"{self.role}-{self.index}")
+
     def events(self) -> list[dict]:
-        """Chrome trace-event dicts for the current ring contents."""
+        """Chrome trace-event dicts for the current ring contents.
+
+        Thread tracks are keyed by (tid, emit-time name): a tid the OS
+        recycled across differently-named threads splits into one track
+        per name (the first name keeps the real tid; later names get
+        synthetic tids), so every span renders under the thread that
+        actually emitted it."""
         pid = os.getpid()
         out: list[dict] = [{
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-            "args": {"name": "deepof_tpu"},
+            "args": {"name": self.process_name()},
         }]
         # snapshot first (C-level copies are GIL-atomic; iterating the
         # live deque while writers append is not)
         threads = dict(self._threads)
         events = list(self._events)
-        for tid in sorted(threads):
-            out.append({"ph": "M", "name": "thread_name", "pid": pid,
-                        "tid": tid, "args": {"name": threads[tid]}})
-        for ph, name, tid, ts, dur, args in events:
+        track: dict[tuple[int, str], int] = {}
+        used: set[int] = set()
+        next_synthetic = max([e[2] for e in events] + list(threads)
+                             + [0]) + 1
+
+        def tid_for(tid: int, tname: str) -> int:
+            nonlocal next_synthetic
+            key = (tid, tname)
+            mapped = track.get(key)
+            if mapped is None:
+                if tid not in used:
+                    mapped = tid
+                else:  # recycled ident: a fresh synthetic track
+                    mapped = next_synthetic
+                    next_synthetic += 1
+                used.add(mapped)
+                track[key] = mapped
+            return mapped
+
+        body: list[dict] = []
+        for ph, name, tid, tname, ts, dur, args in events:
             ev: dict = {"ph": ph, "name": name, "cat": "obs", "pid": pid,
-                        "tid": tid, "ts": round(ts, 1)}
+                        "tid": tid_for(tid, tname), "ts": round(ts, 1)}
             if ph == "X":
                 ev["dur"] = round(dur, 1)
             else:
                 ev["s"] = "g"  # instants render process-wide
             if args:
                 ev["args"] = args
-            out.append(ev)
+            body.append(ev)
+        # registry-only threads (all their events evicted) still get a
+        # track name; an entry contradicting an emit-time binding maps
+        # to its own synthetic track instead of renaming the real one
+        for tid in sorted(threads):
+            tid_for(tid, threads[tid])
+        for (tid, tname), mapped in sorted(track.items(),
+                                           key=lambda kv: kv[1]):
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": mapped, "args": {"name": tname}})
+        out.extend(body)
         return out
 
     def flush(self, path: str | None = None) -> str | None:
@@ -179,6 +253,10 @@ class Tracer:
                 "trace_epoch_unix": self._epoch_unix,
                 "ring_size": self.ring_size,
                 "dropped_spans": self._dropped,
+                # process identity for obs/aggregate.py's fleet merge
+                "role": self.role,
+                "index": self.index,
+                "pid": os.getpid(),
             },
         }
         d = os.path.dirname(os.path.abspath(path))
@@ -205,6 +283,40 @@ def install(tracer: Tracer) -> Tracer:
     with _install_lock:
         _current = tracer
     return tracer
+
+
+class _Installed:
+    """Scope guard returned by installed(); see its docstring."""
+
+    def __init__(self, tracer: Tracer | None):
+        self.tracer = tracer
+
+    def __enter__(self) -> Tracer | None:
+        return self.tracer
+
+    def __exit__(self, *exc) -> bool:
+        if self.tracer is not None:
+            uninstall()
+            try:
+                self.tracer.flush()
+            except OSError:
+                pass
+        return False
+
+
+def installed(tracer: Tracer | None) -> _Installed:
+    """Install `tracer` for the duration of a with-block and make the
+    teardown STRUCTURAL: uninstall + best-effort flush on ANY exit —
+    clean return, SIGTERM-driven drain, or a failure anywhere in the
+    body (a bind error, a failed restore/compile). The spans leading
+    into a startup failure are exactly what an early-installed tracer
+    exists to capture, and the process-global current tracer must never
+    outlive its run (a later run would silently record into the dead
+    ring). `tracer=None` (tracing off) makes the whole block a no-op,
+    so call sites need no conditional."""
+    if tracer is not None:
+        install(tracer)
+    return _Installed(tracer)
 
 
 def uninstall() -> None:

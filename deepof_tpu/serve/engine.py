@@ -58,6 +58,7 @@ import numpy as np
 
 from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
+from ..obs.export import LatencyHistogram, slo_state, validate_slo
 from .buckets import flow_to_native, pick_bucket, prepare_pair, resolve_buckets
 from .quant import dequantize_params, quantize_params, resolve_precisions
 
@@ -198,6 +199,8 @@ class InferenceEngine:
         self.max_batch = max(int(cfg.serve.max_batch), 1)
         self.timeout_s = max(float(cfg.serve.batch_timeout_ms), 0.0) / 1e3
         self.buckets = resolve_buckets(cfg)
+        if float(cfg.obs.slo_latency_ms) > 0:
+            validate_slo(cfg.obs)  # an unmeasurable SLO target fails HERE
         # precision tiers: one executable per (bucket, tier); the
         # config's first entry is the default a request gets when it
         # names none (serve/quant.py owns the transforms)
@@ -290,7 +293,14 @@ class InferenceEngine:
         self._last_occupancy = 0
         self._max_queue_depth = 0
         self._submitting = 0  # submit() threads currently inside put()
+        # server-side failures only (dispatch/postprocess/engine_closed):
+        # the SLO error budget must not burn on a CALLER's bad input
+        self._server_errors = 0
         self._latency_s: deque = deque(maxlen=_LATENCY_WINDOW)
+        # fixed-bucket latency histogram (obs/export.py): the scrapeable
+        # /metrics face of the latency story — fixed log-spaced buckets,
+        # so replica histograms merge EXACTLY at the router
+        self._hist = LatencyHistogram()
         # per-second completion buckets for requests/s — unlike reusing
         # the latency deque, this can't clamp the rate at high load
         self._done_per_s: dict[int, int] = {}
@@ -327,11 +337,16 @@ class InferenceEngine:
                 f"{list(self.tiers)}", rid)
         return tier
 
-    def submit(self, prev, nxt, precision: str | None = None) -> Future:
+    def submit(self, prev, nxt, precision: str | None = None,
+               request_id: int | str | None = None) -> Future:
         """Enqueue one (prev, next) pair — paths or decoded BGR arrays.
 
         precision: serving tier ("f32" | "bf16" | "int8"); must be in
         cfg.serve.precisions; None = the config's first (default) tier.
+        request_id: external correlation id (the router's X-Request-Id)
+        stamped on this request's spans and echoed in the response, so
+        obs/aggregate.py can chain the request's timeline across the
+        router and this replica; None = a process-local sequence id.
 
         Returns a Future resolving to {"flow": (H_native, W_native, 2)
         float32 in native pixel units, "bucket", "precision",
@@ -339,7 +354,7 @@ class InferenceEngine:
         ServeError from .result(). Decode/preprocess errors fail HERE
         (this request only) — they never enter the batcher.
         """
-        rid = next(self._rid)
+        rid = request_id if request_id is not None else next(self._rid)
         fut: Future = Future()
         with self._stats_lock:
             self._requests += 1
@@ -365,11 +380,12 @@ class InferenceEngine:
 
     def submit_prepared(self, x: np.ndarray, bucket: tuple[int, int],
                         native_hw: tuple[int, int],
-                        precision: str | None = None) -> Future:
+                        precision: str | None = None,
+                        request_id: int | str | None = None) -> Future:
         """Enqueue an already-preprocessed row (offline mode: the
         data/pipeline.py worker pool runs prepare_pair concurrently and
         feeds rows here in order)."""
-        rid = next(self._rid)
+        rid = request_id if request_id is not None else next(self._rid)
         fut: Future = Future()
         with self._stats_lock:
             self._requests += 1
@@ -415,6 +431,8 @@ class InferenceEngine:
     def _fail(self, fut: Future, err: ServeError) -> None:
         with self._stats_lock:
             self._errors += 1
+            if err.code not in ("bad_input", "bad_request"):
+                self._server_errors += 1  # burns the SLO error budget
         fut.set_exception(err)
 
     # ----------------------------------------------------------- batcher
@@ -430,7 +448,7 @@ class InferenceEngine:
                 break
             batch = [req]
             timed_out = False
-            with obs_trace.span("serve_batch"):
+            with obs_trace.span("serve_batch") as batch_span:
                 while len(batch) < self.max_batch:
                     rem = (batch[0].t_enq + self.timeout_s) - time.monotonic()
                     try:
@@ -451,6 +469,10 @@ class InferenceEngine:
                                 self._tier_splits += 1
                         break
                     batch.append(nxt)
+                # ids are only known once the batch closed: stamp them
+                # late so aggregate.py can chain the request's timeline
+                batch_span.set(request_ids=[r.rid for r in batch],
+                               occupancy=len(batch))
             if timed_out and len(batch) < self.max_batch:
                 with self._stats_lock:
                     self._timeout_flushes += 1
@@ -471,7 +493,9 @@ class InferenceEngine:
         bucket, tier = batch[0].key
         n = len(batch)
         tag = f"{bucket[0]}x{bucket[1]}/{tier}"
-        with obs_trace.span("serve_dispatch", occupancy=n, bucket=tag):
+        rids = [r.rid for r in batch]
+        with obs_trace.span("serve_dispatch", occupancy=n, bucket=tag,
+                            request_ids=rids):
             x = np.zeros((self.max_batch, bucket[0], bucket[1],
                           batch[0].x.shape[-1]), np.float32)
             for i, r in enumerate(batch):
@@ -485,7 +509,8 @@ class InferenceEngine:
                     self._fail(r.future, ServeError(
                         "dispatch_failed", f"{type(e).__name__}: {e}", r.rid))
                 return
-        with obs_trace.span("serve_postprocess", occupancy=n, bucket=tag):
+        with obs_trace.span("serve_postprocess", occupancy=n, bucket=tag,
+                            request_ids=rids):
             for i, r in enumerate(batch):
                 try:
                     flow = flow_to_native(out[i], self.cfg, bucket,
@@ -496,6 +521,7 @@ class InferenceEngine:
                         f"{type(e).__name__}: {e}", r.rid))
                     continue
                 done = time.monotonic()
+                self._hist.observe(done - r.t_enq)
                 with self._stats_lock:
                     self._responses += 1
                     self._responses_by_tier[r.tier] += 1
@@ -581,6 +607,11 @@ class InferenceEngine:
                 "serve_requests": self._requests,
                 "serve_responses": self._responses,
                 "serve_errors": self._errors,
+                # server-side subset of serve_errors (dispatch/
+                # postprocess/engine_closed — NOT client bad input): the
+                # count that distinguishes a failing executor from noisy
+                # clients, and the one the fleet scrape can sum
+                "serve_server_errors": self._server_errors,
                 "serve_batches": self._batches,
                 "serve_dispatch_failures": self._dispatch_failures,
                 "serve_bucket_splits": self._bucket_splits,
@@ -607,6 +638,18 @@ class InferenceEngine:
             out["serve_latency_p50_ms"] = None
             out["serve_latency_p99_ms"] = None
         out["serve_requests_per_s"] = round(recent / _RATE_WINDOW_S, 3)
+        # fixed-bucket histogram + SLO state (obs/export.py): the
+        # scrapeable /metrics face; replica histograms merge exactly at
+        # the router because the buckets are fixed by contract
+        hist = self._hist.snapshot()
+        out["serve_latency_hist"] = hist
+        if float(self.cfg.obs.slo_latency_ms) > 0:
+            with self._stats_lock:
+                requests, failures = self._requests, self._server_errors
+            out["serve_slo"] = slo_state(
+                hist, requests, failures,
+                self.cfg.obs.slo_latency_ms,
+                self.cfg.obs.slo_error_budget)
         return out
 
     def heartbeat_sample(self) -> dict:

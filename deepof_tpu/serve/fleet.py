@@ -565,6 +565,24 @@ def run_fleet(cfg: ExperimentConfig, replicas: int | None = None) -> int:
     """`deepof_tpu serve --replicas N`: fleet + router + fleet heartbeat,
     serving until SIGINT/SIGTERM, then graceful drain (stop admission,
     flush in-flight, reap replicas). Blocks; returns the exit code."""
+    from ..obs import trace as obs_trace
+
+    # router-side span tracer: every admitted request's `route` span
+    # (request_id-stamped) lands in <log_dir>/trace.json, the half
+    # obs/aggregate.py joins with the replicas' serve_* spans into one
+    # fleet timeline. obs_trace.installed() makes uninstall + flush
+    # structural on EVERY exit, including a failed start() or an
+    # EADDRINUSE bind below.
+    tracer = None
+    if cfg.obs.trace:
+        tracer = obs_trace.Tracer(
+            path=os.path.join(cfg.train.log_dir, "trace.json"),
+            ring_size=cfg.obs.trace_ring, role="router")
+    with obs_trace.installed(tracer):
+        return _run_fleet(cfg, replicas)
+
+
+def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
     from ..obs.heartbeat import Heartbeat
     from .router import Router, build_router_server
 

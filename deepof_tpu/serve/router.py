@@ -46,6 +46,7 @@ import base64
 import http.client
 import itertools
 import json
+import os
 import struct
 import threading
 import time
@@ -53,6 +54,9 @@ from collections import defaultdict
 from typing import Callable
 
 from ..core.config import ExperimentConfig
+from ..obs import trace as obs_trace
+from ..obs.export import (LatencyHistogram, is_hist_snapshot, merge_hists,
+                          render_prometheus, slo_state, validate_slo)
 from .buckets import pick_bucket, resolve_buckets
 from .quant import resolve_precisions
 
@@ -114,6 +118,8 @@ class Router:
         # spreads that FLATTENED ladder across the fleet — bucket b at
         # tier t concentrates on replica (b_idx * n_tiers + t_idx) % N
         self.tiers = resolve_precisions(cfg)
+        if float(cfg.obs.slo_latency_ms) > 0:
+            validate_slo(cfg.obs)  # an unmeasurable SLO target fails HERE
         self.retries = max(int(fc.failover_retries), 0)
         self.max_in_flight = max(int(fc.max_in_flight), 1)
         # spill is a preference bound INSIDE the hard cap — past the cap
@@ -136,7 +142,20 @@ class Router:
         self._retries = 0     # individual replay attempts
         self._shed = 0        # 503 overloaded (all replicas saturated)
         self._unavailable = 0  # 503 no ready replica at all
+        # requests the FLEET failed (shed + unavailable + exhausted
+        # failover): the SLO error budget's failure count — relayed
+        # client 4xx deliberately excluded
+        self._server_errors = 0
         self._rr = itertools.count()  # unaffinitized round-robin cursor
+        # front-door latency histogram (obs/export.py fixed buckets):
+        # admission -> reply, including failover replays — the number a
+        # client actually experiences, distinct from the per-replica
+        # engine histograms /metrics aggregates alongside it
+        self._hist = LatencyHistogram()
+        # X-Request-Id sequence: globally unique enough (router pid +
+        # counter) to chain one request's spans across processes in the
+        # merged fleet trace
+        self._rid_seq = itertools.count(1)
 
     # ---------------------------------------------------------- routing
     def _preferred(self, key) -> int:
@@ -187,12 +206,17 @@ class Router:
         with self._lock:
             self._in_flight[idx] -= 1
 
-    def _proxy(self, replica, path: str, body: bytes, ctype: str):
+    def _proxy(self, replica, path: str, body: bytes, ctype: str,
+               request_id: str | None = None):
         conn = http.client.HTTPConnection(self.fleet.host, replica.port,
                                           timeout=self.timeout_s)
+        headers = {"Content-Type": ctype or "application/json"}
+        if request_id is not None:
+            # the replica stamps this id on its engine spans: the merged
+            # fleet trace chains router -> replica per request
+            headers["X-Request-Id"] = request_id
         try:
-            conn.request("POST", path, body,
-                         {"Content-Type": ctype or "application/json"})
+            conn.request("POST", path, body, headers)
             resp = conn.getresponse()
             return (resp.status, resp.read(),
                     resp.getheader("Content-Type") or "application/json")
@@ -228,9 +252,21 @@ class Router:
     def handle_flow(self, path: str, body: bytes,
                     ctype: str) -> tuple[int, bytes, str]:
         """Route one POST /v1/flow: returns (status, payload, ctype) —
-        always; a request admitted here cannot be silently dropped."""
+        always; a request admitted here cannot be silently dropped.
+        Every admitted request gets an X-Request-Id (router pid + seq)
+        stamped downstream, a `route` span on the router's tracer, and
+        a front-door latency observation on success."""
+        rid = f"r{os.getpid():x}-{next(self._rid_seq)}"
+        t0 = time.monotonic()
         with self._lock:
             self._requests += 1
+        with obs_trace.span("route", request_id=rid) as span:
+            status, payload, rtype = self._route(path, body, ctype, rid,
+                                                 t0, span)
+        return status, payload, rtype
+
+    def _route(self, path: str, body: bytes, ctype: str, rid: str,
+               t0: float, span) -> tuple[int, bytes, str]:
         key = self.route_key(body)
         tried: set[int] = set()
         last_error = None
@@ -241,10 +277,12 @@ class Router:
                     break  # fall through to the structured 502
                 with self._lock:
                     self._errors += 1
+                    self._server_errors += 1
                     if reason == "overloaded":
                         self._shed += 1
                     else:
                         self._unavailable += 1
+                span.set(outcome=reason)
                 msg = ("every replica is saturated — retry later"
                        if reason == "overloaded"
                        else "no healthy replica available")
@@ -253,7 +291,7 @@ class Router:
                         "application/json")
             try:
                 status, payload, rtype = self._proxy(replica, path, body,
-                                                     ctype)
+                                                     ctype, request_id=rid)
             except Exception as e:  # noqa: BLE001 - transport = failover
                 self._release(replica.idx)
                 last_error = f"{type(e).__name__}: {e}"
@@ -280,6 +318,10 @@ class Router:
                 else:
                     self._errors += 1  # structured client error, relayed
                     total = None
+            if status < 400:
+                self._hist.observe(time.monotonic() - t0)
+            span.set(replica=replica.idx, status=status,
+                     attempts=attempt + 1)
             hook = self.beat_hook
             if total is not None and hook is not None:
                 try:
@@ -289,6 +331,8 @@ class Router:
             return status, payload, rtype
         with self._lock:
             self._errors += 1
+            self._server_errors += 1
+        span.set(outcome="replica_failed", attempts=max(len(tried), 1))
         return (502, json.dumps({
             "error": "replica_failed",
             "message": f"request failed on {max(len(tried), 1)} replica(s); "
@@ -303,12 +347,16 @@ class Router:
 
     def stats(self) -> dict:
         """The router's half of the fleet_* counter block (the fleet
-        heartbeat merges it with Fleet.stats())."""
+        heartbeat merges it with Fleet.stats()), including the
+        front-door latency histogram and — when cfg.obs.slo_latency_ms
+        is set — the fleet SLO state the error budget burns against."""
+        hist = self._hist.snapshot()
         with self._lock:
-            return {
+            out = {
                 "fleet_requests": self._requests,
                 "fleet_responses": self._responses,
                 "fleet_errors": self._errors,
+                "fleet_server_errors": self._server_errors,
                 "fleet_failovers": self._failovers,
                 "fleet_retries": self._retries,
                 "fleet_shed": self._shed,
@@ -318,6 +366,101 @@ class Router:
                                  for i, n in sorted(self._routed.items())},
                 "fleet_draining": self.draining,
             }
+            requests, failures = self._requests, self._server_errors
+        out["fleet_latency_hist"] = hist
+        if float(self.cfg.obs.slo_latency_ms) > 0:
+            # the router's own histogram IS the burn source: it sees
+            # every admitted request, including ones no replica answered
+            out["fleet_slo"] = slo_state(hist, requests, failures,
+                                         self.cfg.obs.slo_latency_ms,
+                                         self.cfg.obs.slo_error_budget)
+        return out
+
+    # ---------------------------------------------------------- /metrics
+    #: serve_* keys that are per-replica configuration or instantaneous
+    #: occupancy — summing them across the fleet would export nonsense
+    #: (a 2-replica fleet does not have max_batch 16)
+    _SCRAPE_SKIP = frozenset((
+        "serve_max_batch", "serve_buckets", "serve_tiers",
+        "serve_last_occupancy"))
+    #: per-replica high-water marks: the honest fleet value is the max
+    _SCRAPE_MAX = frozenset(("serve_max_queue_depth",))
+
+    def scrape_replicas(self, timeout_s: float = 2.0) -> dict:
+        """Fleet-aggregated serve_* block: GET /healthz on every ready
+        replica (concurrently — one wedged-but-still-ready replica must
+        cost at most ONE timeout, not one per scrape position) and
+        merge — additive counters sum, per-tier maps sum by key,
+        high-water marks take the max, per-replica config keys are
+        dropped, and the latency histograms merge EXACTLY (fixed shared
+        buckets, obs/export.py) so the fleet-wide bucket counts equal
+        the sum of the replicas' at scrape time. Replicas that fail the
+        scrape are skipped and counted."""
+        def fetch(replica):
+            conn = http.client.HTTPConnection(
+                self.fleet.host, replica.port,
+                timeout=max(float(timeout_s), 0.1))
+            try:
+                conn.request("GET", "/healthz")
+                return json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+
+        replicas = self.fleet.ready_replicas()
+        results: list[dict | None] = []
+        if replicas:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=len(replicas)) as pool:
+                futures = [pool.submit(fetch, r) for r in replicas]
+                for fut in futures:
+                    try:
+                        results.append(fut.result())
+                    except Exception:  # noqa: BLE001 - sick replica: skip
+                        results.append(None)
+        totals: dict = {}
+        maxima: dict = {}
+        by_tier: dict[str, dict] = defaultdict(lambda: defaultdict(int))
+        hists: list[dict] = []
+        scraped = failed = 0
+        for stats in results:
+            if stats is None:
+                failed += 1
+                continue
+            scraped += 1
+            for k, v in stats.items():
+                if not k.startswith("serve_") or k in self._SCRAPE_SKIP:
+                    continue
+                if is_hist_snapshot(v):
+                    hists.append(v)
+                elif k in ("serve_requests_by_tier",
+                           "serve_responses_by_tier") \
+                        and isinstance(v, dict):
+                    for tier, n in v.items():
+                        if isinstance(n, (int, float)):
+                            by_tier[k][tier] += n
+                elif isinstance(v, bool):
+                    continue
+                elif k in self._SCRAPE_MAX and isinstance(v, (int, float)):
+                    maxima[k] = max(maxima.get(k, 0), v)
+                elif isinstance(v, (int, float)) and not k.endswith(
+                        ("_p50_ms", "_p99_ms", "_per_s", "_mean")):
+                    # sums only: percentiles/rates/means do not add —
+                    # the merged histogram is the honest fleet latency
+                    totals[k] = totals.get(k, 0) + v
+        out = {**totals, **maxima}
+        out.update({k: dict(v) for k, v in by_tier.items()})
+        if hists:
+            out["serve_latency_hist"] = merge_hists(hists)
+        out["serve_replicas_scraped"] = scraped
+        out["serve_replicas_scrape_failed"] = failed
+        return out
+
+    def metrics_text(self) -> str:
+        """GET /metrics body: supervisor + router + fleet-aggregated
+        replica blocks in Prometheus text format."""
+        return render_prometheus({**self.fleet.stats(), **self.stats(),
+                                  **self.scrape_replicas()})
 
 
 def build_router_server(cfg: ExperimentConfig, router: Router):
@@ -362,6 +505,14 @@ def build_router_server(cfg: ExperimentConfig, router: Router):
                 ok = payload.get("fleet_ready", 0) > 0 and not router.draining
                 self._reply(200 if ok else 503,
                             json.dumps(payload).encode())
+            elif self.path == "/metrics":
+                from ..obs.export import PROM_CONTENT_TYPE
+
+                # fleet-aggregated Prometheus scrape: fleet_* + router
+                # counters + the replicas' serve_* blocks merged live
+                # (histogram bucket counts = exact sum of the replicas')
+                self._reply(200, router.metrics_text().encode(),
+                            PROM_CONTENT_TYPE)
             else:
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})

@@ -51,6 +51,8 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from ..core.config import ExperimentConfig
 from ..io.flo import flo_bytes
+from ..obs import trace as obs_trace
+from ..obs.export import PROM_CONTENT_TYPE, render_prometheus
 from .engine import InferenceEngine, ServeError
 
 #: Replica identity exported by the fleet supervisor (serve/fleet.py) to
@@ -161,6 +163,11 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
             if self.path in ("/healthz", "/stats"):
                 self._reply_json(200, engine.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition of the live serve_* block
+                # (counters, fixed-bucket latency histogram, SLO state)
+                self._reply(200, render_prometheus(engine.stats()).encode(),
+                            PROM_CONTENT_TYPE)
             else:
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
@@ -170,6 +177,10 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
                 return
+            # the router's correlation id: stamped on this request's
+            # engine spans and echoed back, so the merged fleet trace
+            # chains router -> replica for this request
+            request_id = self.headers.get("X-Request-Id")
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
@@ -188,7 +199,8 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 self._reply_json(400, {"error": "bad_request",
                                        "message": f"{type(e).__name__}: {e}"})
                 return
-            fut = engine.submit(prev, nxt, precision=precision)
+            fut = engine.submit(prev, nxt, precision=precision,
+                                request_id=request_id)
             try:
                 res = fut.result(timeout=timeout_s)
             except ServeError as e:
@@ -257,74 +269,98 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
     hard stop for a wedged drain."""
     from ..obs.heartbeat import Heartbeat
 
+    # span tracer BEFORE the engine so the warm() compile spans land on
+    # the timeline; (role, index) stamp the trace for obs/aggregate.py's
+    # fleet merge. obs_trace.installed() makes the teardown structural:
+    # uninstall + flush on ANY exit — clean drain, ^C, or a startup
+    # failure anywhere below (restore/compile raising, the bind failing
+    # with EADDRINUSE) — so the spans leading into a failure are never
+    # lost and the process-global tracer never outlives this serve run.
+    # (The watchdog additionally flushes mid-run on a wedge, so the
+    # timeline into a stall survives even a SIGKILL eviction.)
+    tracer = None
+    if cfg.obs.trace:
+        tracer = obs_trace.Tracer(
+            path=os.path.join(cfg.train.log_dir, "trace.json"),
+            ring_size=cfg.obs.trace_ring,
+            role="replica" if os.environ.get(REPLICA_ENV) else "serve",
+            index=replica_index())
     own_engine = engine is None
-    if own_engine:
-        engine = InferenceEngine(cfg, model_params=model_params)
-    install_replica_faults(engine, cfg)
-    warm = engine.warm()
-
-    # serve heartbeat: flushes are the "steps"; with NO work in flight
-    # (every submitted request answered — not merely an empty queue,
-    # which would also mask a dispatch hung inside the device call) the
-    # clock is touch()ed so an idle endpoint is never declared wedged —
-    # only pending-but-stalled requests are
-    hb_ref: dict = {}
-
-    def sample() -> dict:
-        s = engine.heartbeat_sample()
-        in_flight = (s.get("serve_requests", 0)
-                     - s.get("serve_responses", 0) - s.get("serve_errors", 0))
-        if in_flight <= 0 and "hb" in hb_ref:
-            hb_ref["hb"].touch()
-        return s
-
-    hb = Heartbeat(os.path.join(cfg.train.log_dir, "heartbeat.json"),
-                   period_s=cfg.obs.heartbeat_period_s,
-                   watchdog_factor=cfg.obs.watchdog_factor,
-                   watchdog_min_s=cfg.obs.watchdog_min_s,
-                   sample=sample,
-                   # a fake-executor replica stays jax-free end to end
-                   devmem=cfg.serve.fake_exec_ms is None)
-    hb_ref["hb"] = hb
-    engine.flush_hook = hb.beat
-    httpd = build_server(cfg, engine)
-    host, port = httpd.server_address[:2]
-
-    # graceful drain on SIGTERM (main thread only — tests drive
-    # build_server directly): first signal stops admission; the finally
-    # block below flushes in-flight work before exiting. Restoring the
-    # default action afterwards lets a second SIGTERM kill a wedged
-    # drain outright (the train loop's two-step convention).
-    if threading.current_thread() is threading.main_thread():
-        def _on_term(signum, frame):
-            signal.signal(signal.SIGTERM, signal.SIG_DFL)
-            # shutdown() blocks until serve_forever returns; hop threads
-            # so the handler itself never deadlocks the serve loop
-            threading.Thread(target=httpd.shutdown, daemon=True,
-                             name="serve-drain").start()
-
-        signal.signal(signal.SIGTERM, _on_term)
-
-    print(json.dumps({"serving": f"http://{host}:{port}",
-                      "pid": os.getpid(),
-                      "replica": replica_index(),
-                      "buckets": [list(b) for b in engine.buckets],
-                      "precisions": list(engine.tiers),
-                      "max_batch": engine.max_batch,
-                      "warm": warm.get("cache")}), flush=True)
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        httpd.server_close()  # admission stopped: no new connections
-        # flush in-flight: handler threads are still parked on futures;
-        # give the batcher a bounded window to resolve them all
-        drain_engine(engine, cfg.serve.fleet.drain_timeout_s)
+    with obs_trace.installed(tracer):
         if own_engine:
-            engine.close()
-        _log_serve_summary(cfg, engine)
-        hb.close()
+            engine = InferenceEngine(cfg, model_params=model_params)
+        install_replica_faults(engine, cfg)
+        warm = engine.warm()
+
+        # serve heartbeat: flushes are the "steps"; with NO work in
+        # flight (every submitted request answered — not merely an empty
+        # queue, which would also mask a dispatch hung inside the device
+        # call) the clock is touch()ed so an idle endpoint is never
+        # declared wedged — only pending-but-stalled requests are
+        hb_ref: dict = {}
+
+        def sample() -> dict:
+            s = engine.heartbeat_sample()
+            in_flight = (s.get("serve_requests", 0)
+                         - s.get("serve_responses", 0)
+                         - s.get("serve_errors", 0))
+            if in_flight <= 0 and "hb" in hb_ref:
+                hb_ref["hb"].touch()
+            return s
+
+        hb = Heartbeat(os.path.join(cfg.train.log_dir, "heartbeat.json"),
+                       period_s=cfg.obs.heartbeat_period_s,
+                       watchdog_factor=cfg.obs.watchdog_factor,
+                       watchdog_min_s=cfg.obs.watchdog_min_s,
+                       sample=sample,
+                       # a fake-executor replica stays jax-free end to end
+                       devmem=cfg.serve.fake_exec_ms is None)
+        hb_ref["hb"] = hb
+        engine.flush_hook = hb.beat
+        try:
+            httpd = build_server(cfg, engine)
+        except BaseException:
+            hb.close()  # bind failure: the heartbeat thread must not leak
+            raise
+        host, port = httpd.server_address[:2]
+
+        # graceful drain on SIGTERM (main thread only — tests drive
+        # build_server directly): first signal stops admission; the
+        # finally block below flushes in-flight work before exiting.
+        # Restoring the default action afterwards lets a second SIGTERM
+        # kill a wedged drain outright (the train loop's two-step
+        # convention).
+        if threading.current_thread() is threading.main_thread():
+            def _on_term(signum, frame):
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                # shutdown() blocks until serve_forever returns; hop
+                # threads so the handler itself never deadlocks the
+                # serve loop
+                threading.Thread(target=httpd.shutdown, daemon=True,
+                                 name="serve-drain").start()
+
+            signal.signal(signal.SIGTERM, _on_term)
+
+        print(json.dumps({"serving": f"http://{host}:{port}",
+                          "pid": os.getpid(),
+                          "replica": replica_index(),
+                          "buckets": [list(b) for b in engine.buckets],
+                          "precisions": list(engine.tiers),
+                          "max_batch": engine.max_batch,
+                          "warm": warm.get("cache")}), flush=True)
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()  # admission stopped: no new connections
+            # flush in-flight: handler threads are still parked on
+            # futures; give the batcher a bounded window to resolve them
+            drain_engine(engine, cfg.serve.fleet.drain_timeout_s)
+            if own_engine:
+                engine.close()
+            _log_serve_summary(cfg, engine)
+            hb.close()
     return 0
 
 

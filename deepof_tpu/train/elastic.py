@@ -808,6 +808,7 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
 
     coord = ElasticCoordinator(cfg, hosts=hosts, target_step=max_steps)
     hb = None
+    metrics_srv = None
     rc = 1
     # graceful-stop handler BEFORE any child exists: a preemption
     # SIGTERM landing mid-start() would otherwise take the default
@@ -844,6 +845,20 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
         hb_ref["hb"] = hb
         coord.beat_hook = hb.beat
 
+        if cfg.obs.metrics_port is not None:
+            # scrapeable elastic_* block (obs/export.py): GET /metrics
+            # (Prometheus text) + /healthz (JSON) on the coordinator —
+            # the pool's generation/reform/lost-host counters become
+            # dashboard series instead of a heartbeat file read
+            from ..obs.export import start_metrics_server
+
+            metrics_srv = start_metrics_server(
+                coord.stats, port=int(cfg.obs.metrics_port))
+            print(json.dumps(
+                {"metrics": f"http://127.0.0.1:"
+                            f"{metrics_srv.server_address[1]}/metrics"}),
+                flush=True)
+
         try:
             rc = coord.run()
         except KeyboardInterrupt:
@@ -854,6 +869,9 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
     finally:
         coord.close()  # every exit path: no orphaned trainer sessions
         coord._write_record()
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            metrics_srv.server_close()
         if hb is not None:
             hb.close()
         print(json.dumps(

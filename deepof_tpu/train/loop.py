@@ -448,9 +448,13 @@ class Trainer:
         primary = jax.process_index() == 0
         tracer = None
         if cfg.obs.trace and primary:
+            # (role, index) stamp the trace so obs/aggregate.py can
+            # merge an elastic pool's per-host timelines; host_index < 0
+            # (plain single-process training) stamps trainer-0
             tracer = obs_trace.install(obs_trace.Tracer(
                 path=os.path.join(cfg.train.log_dir, "trace.json"),
-                ring_size=cfg.obs.trace_ring))
+                ring_size=cfg.obs.trace_ring, role="trainer",
+                index=max(cfg.elastic.host_index, 0)))
 
         def _obs_teardown() -> None:
             # construction-failure path: the process-global tracer must

@@ -1,0 +1,542 @@
+"""Fleet-wide observability plane (ISSUE 9, DESIGN.md "Fleet
+observability"): fixed-bucket latency histograms that merge exactly,
+Prometheus /metrics rendering + parsing, the SLO error-budget layer,
+emit-time thread naming (the tid-recycle fix), multi-process trace
+aggregation with request-id flow arrows, `trace_summary --merge`,
+`tail --fleet` / rc 6, and the 2-replica fleet drill acceptance
+(router /metrics histogram == exact sum of the replicas').
+
+Fast tier throughout; the drill test spawns two jax-free fake-executor
+replica subprocesses (same cost profile as the test_fleet chaos tier).
+"""
+
+import dataclasses
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import wait_for_listen
+
+from deepof_tpu.core.config import get_config
+from deepof_tpu.obs import aggregate, trace as obs_trace
+from deepof_tpu.obs.export import (LATENCY_BUCKETS_MS, LatencyHistogram,
+                                   merge_hists, parse_prometheus,
+                                   render_prometheus, slo_state,
+                                   start_metrics_server)
+from deepof_tpu.obs.trace import Tracer
+from deepof_tpu.serve.engine import InferenceEngine, make_fake_forward
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _serve_cfg(log_dir, max_batch=4, timeout_ms=5.0, slo_ms=0.0,
+               budget=0.01):
+    cfg = get_config("flyingchairs")
+    return cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(cfg.serve, max_batch=max_batch,
+                                  batch_timeout_ms=timeout_ms,
+                                  host="127.0.0.1", port=0),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(log_dir)),
+        obs=dataclasses.replace(cfg.obs, slo_latency_ms=slo_ms,
+                                slo_error_budget=budget))
+
+
+def _pair(rng, hw=(30, 60)):
+    return (rng.randint(0, 255, (*hw, 3), dtype=np.uint8),
+            rng.randint(0, 255, (*hw, 3), dtype=np.uint8))
+
+
+# ------------------------------------------------------------ histogram
+
+
+def test_latency_histogram_fixed_buckets_merge_exactly(rng):
+    """The bucket contract: snapshots from independent histograms merge
+    by element-wise sum — bucket counts, total count, and sum all equal
+    the arithmetic sums (no approximation anywhere)."""
+    hists = [LatencyHistogram() for _ in range(3)]
+    for h in hists:
+        for _ in range(200):
+            h.observe(float(rng.uniform(0, 3.0)))
+    snaps = [h.snapshot() for h in hists]
+    merged = merge_hists(snaps)
+    assert merged["count"] == 600
+    for i in range(len(LATENCY_BUCKETS_MS) + 1):
+        assert merged["counts"][i] == sum(s["counts"][i] for s in snaps)
+    assert merged["sum_ms"] == pytest.approx(
+        sum(s["sum_ms"] for s in snaps), abs=0.01)
+    # a foreign bucket layout must fail loudly, never merge approximately
+    bad = dict(snaps[0], buckets_ms=[1.0, 2.0])
+    with pytest.raises(ValueError):
+        merge_hists([bad])
+
+
+def test_prometheus_render_parse_round_trip():
+    h = LatencyHistogram()
+    for ms in (0.4, 3.0, 700.0, 99999.0):
+        h.observe(ms / 1e3)
+    stats = {"serve_requests": 7, "serve_errors": 0, "flag": True,
+             "skipped": None, "name": "ignored-string",
+             "serve_requests_by_tier": {"f32": 5, "int8": 2},
+             "fleet_states": {"replica-0": "ready", "replica-1": "backoff"},
+             "serve_latency_hist": h.snapshot()}
+    parsed = parse_prometheus(render_prometheus(stats))
+    assert parsed["deepof_serve_requests"] == 7
+    assert parsed["deepof_flag"] == 1
+    assert parsed['deepof_serve_requests_by_tier{key="int8"}'] == 2
+    assert parsed['deepof_fleet_states{key="replica-1",value="backoff"}'] == 1
+    # histogram: cumulative buckets, +Inf carries the total
+    assert parsed['deepof_serve_latency_ms_bucket{le="+Inf"}'] == 4
+    assert parsed['deepof_serve_latency_ms_bucket{le="0.5"}'] == 1
+    assert parsed["deepof_serve_latency_ms_count"] == 4
+    # the beyond-last-bound observation lives only in +Inf
+    assert parsed['deepof_serve_latency_ms_bucket{le="16384"}'] == 3
+    assert "deepof_skipped" not in parsed and "deepof_name" not in parsed
+
+
+def test_slo_state_burn_and_exhaustion():
+    h = LatencyHistogram()
+    for _ in range(90):
+        h.observe(0.010)  # 10 ms: inside a 16 ms target
+    for _ in range(10):
+        h.observe(0.500)  # 500 ms: breaches
+    ok = slo_state(h.snapshot(), requests=100, failures=0,
+                   latency_ms=16.0, error_budget=0.2)
+    assert ok["breaches"] == 10 and ok["bucket_ms"] == 16.0
+    assert ok["burn"] == pytest.approx(0.5)
+    assert not ok["exhausted"]
+    # failures burn the same budget; a 10% budget is now exhausted
+    bad = slo_state(h.snapshot(), requests=100, failures=5,
+                    latency_ms=16.0, error_budget=0.1)
+    assert bad["breaches"] == 10 and bad["failures"] == 5
+    assert bad["exhausted"] and bad["burn"] == pytest.approx(1.5)
+    # a target between bounds rounds UP to the next bucket bound (the
+    # merge-stable threshold)
+    assert slo_state(h.snapshot(), 100, 0, 10.0, 0.5)["bucket_ms"] == 16.0
+    # no traffic: never exhausted
+    assert not slo_state(None, 0, 0, 16.0, 0.01)["exhausted"]
+
+
+def test_unmeasurable_slo_target_rejected_at_construction(tmp_path):
+    """A latency target past the largest fixed bucket bound could never
+    count a breach — the engine (and router) must refuse it loudly at
+    construction, not serve a silently-never-burning SLO."""
+    cfg = _serve_cfg(tmp_path, slo_ms=LATENCY_BUCKETS_MS[-1] + 1.0)
+    with pytest.raises(ValueError, match="slo_latency_ms"):
+        InferenceEngine(cfg, forward_fn=make_fake_forward(1.0))
+    # the largest bound itself is fine
+    cfg_ok = _serve_cfg(tmp_path, slo_ms=LATENCY_BUCKETS_MS[-1])
+    eng = InferenceEngine(cfg_ok, forward_fn=make_fake_forward(1.0))
+    eng.close()
+    # a zero/negative error budget is equally unmeasurable
+    cfg_budget = _serve_cfg(tmp_path, slo_ms=16.0, budget=0.0)
+    with pytest.raises(ValueError, match="slo_error_budget"):
+        InferenceEngine(cfg_budget, forward_fn=make_fake_forward(1.0))
+
+
+# ------------------------------------------------- emit-time thread name
+
+
+def test_tracer_recycled_tid_keeps_both_thread_names(tmp_path):
+    """The PR 3 hazard: a tid recycled onto a differently-named thread
+    must not retroactively rename earlier spans. Names are captured at
+    emit time; events() splits one tid into per-name tracks."""
+    tr = Tracer(path=str(tmp_path / "t.json"))
+    me = threading.current_thread()
+    orig = me.name
+    try:
+        me.name = "first-owner"
+        with tr.span("early"):
+            pass
+        me.name = "second-owner"  # same ident, new name = recycled tid
+        with tr.span("late"):
+            pass
+    finally:
+        me.name = orig
+    events = tr.events()
+    names = {e["tid"]: e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    spans = {e["name"]: e["tid"] for e in events if e["ph"] == "X"}
+    assert spans["early"] != spans["late"]  # split tracks
+    assert names[spans["early"]] == "first-owner"
+    assert names[spans["late"]] == "second-owner"
+
+
+def test_tracer_collapses_auto_named_ephemeral_threads(tmp_path):
+    """ThreadingHTTPServer auto-names one thread per request
+    ("Thread-N (process_request_thread)"); a recycled tid under those
+    names must NOT mint one single-span track per request — the serial
+    is dropped, so they share one track."""
+    tr = Tracer(path=str(tmp_path / "t.json"))
+    me = threading.current_thread()
+    orig = me.name
+    try:
+        for n in (7, 8, 9):  # same ident, fresh auto-name per "request"
+            me.name = f"Thread-{n} (process_request_thread)"
+            with tr.span(f"req-{n}"):
+                pass
+    finally:
+        me.name = orig
+    events = tr.events()
+    meta = [e for e in events if e["ph"] == "M"
+            and e["name"] == "thread_name"]
+    tids = {e["tid"] for e in events if e["ph"] == "X"}
+    assert len(tids) == 1  # one track, not three
+    assert any(e["args"]["name"] == "Thread (process_request_thread)"
+               for e in meta)
+
+
+# --------------------------------------------- multi-process aggregation
+
+
+def _write_synthetic_fleet(run_dir):
+    """Router + 2 replicas + a coordinator-style supervisor dir, with
+    cross-process request ids and per-process heartbeat/metrics —
+    the synthetic shape of a real `serve --replicas 2` run dir."""
+    os.makedirs(run_dir, exist_ok=True)
+    router = Tracer(path=os.path.join(run_dir, "trace.json"),
+                    role="router")
+    with router.span("route", request_id="r1-1"):
+        time.sleep(0.002)
+    with router.span("route", request_id="r1-2"):
+        time.sleep(0.001)
+    router.flush()
+    with open(os.path.join(run_dir, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "warn", "step": 0, "time": time.time(),
+                            "message": "fleet replica-1 evicted"}) + "\n")
+    hists = []
+    for i in range(2):
+        rdir = os.path.join(run_dir, f"replica-{i}")
+        os.makedirs(rdir, exist_ok=True)
+        tr = Tracer(path=os.path.join(rdir, "trace.json"),
+                    role="replica", index=i)
+        rid = f"r1-{i + 1}"
+        with tr.span("serve_enqueue", request_id=rid):
+            pass
+        with tr.span("serve_dispatch", request_ids=[rid], occupancy=1):
+            time.sleep(0.001)
+        with tr.span("serve_postprocess", request_ids=[rid], occupancy=1):
+            pass
+        tr.flush()
+        h = LatencyHistogram()
+        for k in range(3 + i):
+            h.observe(0.004 * (k + 1))
+        hists.append(h.snapshot())
+        with open(os.path.join(rdir, "heartbeat.json"), "w") as f:
+            json.dump({"time": time.time(), "pid": os.getpid() + i,
+                       "step": 0, "wedged": False, "serve_requests": 3 + i,
+                       "serve_responses": 3 + i, "serve_errors": 0,
+                       "serve_latency_hist": hists[-1]}, f)
+        with open(os.path.join(rdir, "metrics.jsonl"), "w") as f:
+            f.write(json.dumps({"kind": "serve", "step": 0,
+                                "time": time.time(),
+                                "serve_requests": 3 + i}) + "\n")
+    return hists
+
+
+def test_aggregate_run_pins_merged_trace_schema(tmp_path):
+    """The tentpole pin: a synthetic router + 2 replicas run dir merges
+    into one trace with >= 3 process tracks, per-request flow arrows
+    whose ids chain the SAME request across router and replica, and
+    timestamps on one shared clock."""
+    run = str(tmp_path / "drill")
+    _write_synthetic_fleet(run)
+    summary = aggregate.aggregate_run(run)
+    assert summary["path"] == os.path.join(run, "trace_merged.json")
+    assert summary["requests_correlated"] == 2
+    payload = json.load(open(summary["path"]))
+    events = payload["traceEvents"]
+    tracks = {e["pid"]: e["args"]["name"] for e in events
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(tracks) >= 3
+    names = set(tracks.values())
+    assert any(n.startswith("router") for n in names)
+    assert any(n.startswith("replica-0") for n in names)
+    assert any(n.startswith("replica-1") for n in names)
+    # flow arrows: each correlated request id chains s -> ... -> f, and
+    # its events sit on >= 2 distinct process tracks
+    for rid in ("r1-1", "r1-2"):
+        flow = [e for e in events if e.get("id") == rid
+                and e["ph"] in ("s", "t", "f")]
+        assert [e["ph"] for e in flow][0] == "s"
+        assert [e["ph"] for e in flow][-1] == "f"
+        assert len({e["pid"] for e in flow}) >= 2
+        # arrows bind inside the spans they link: every flow ts must be
+        # >= its span's start on the shared clock
+        assert all(isinstance(e["ts"], (int, float)) for e in flow)
+    # heartbeat + metrics.jsonl landmarks ride along as instants
+    assert any(e["ph"] == "i" and e["name"] == "heartbeat" for e in events)
+    assert any(e["ph"] == "i" and e["name"] == "metrics_warn"
+               for e in events)
+    # per-process pid remap: small distinct pids, originals preserved
+    assert sorted(tracks) == [1, 2, 3]
+
+
+def test_trace_summary_merge_cli(tmp_path, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_summary
+
+    run = str(tmp_path / "drill")
+    _write_synthetic_fleet(run)
+    rc = trace_summary.main(["--merge", run, "--top", "5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "2 correlated across processes" in out
+    assert "router" in out and "replica-1" in out
+    assert "serve_dispatch" in out
+    assert "request journey" in out
+    # and the merged artifact is on disk for Perfetto
+    assert os.path.exists(os.path.join(run, "trace_merged.json"))
+
+
+def test_analyze_and_tail_aggregate_process_dirs(tmp_path):
+    """analyze()/tail --fleet summarize a whole drill dir: per-process
+    blocks plus a merged block whose histogram is the EXACT bucket sum
+    of the children's."""
+    from deepof_tpu.analyze import aggregate_processes, tail_summary
+
+    run = str(tmp_path / "drill")
+    hists = _write_synthetic_fleet(run)
+    # discovery is depth-bounded: an artifact nested BELOW a child (an
+    # old run copied inside, a checkpoint tree) is never adopted as a
+    # phantom process
+    deep = os.path.join(run, "replica-0", "old-copy")
+    os.makedirs(deep)
+    with open(os.path.join(deep, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "serve", "serve_requests": 999,
+                            "time": time.time()}) + "\n")
+    assert [p["rel"] for p in aggregate.discover_processes(run)] == \
+        ["", "replica-0", "replica-1"]
+    agg = aggregate_processes(run)
+    assert set(agg["processes"]) == {"replica-0", "replica-1"}
+    assert agg["processes"]["replica-0"]["serve"]["requests"] == 3
+    merged = agg["merged"]
+    assert merged["requests"] == 7 and merged["responses"] == 7
+    expect = merge_hists(hists)
+    assert merged["latency_hist"]["counts"] == expect["counts"]
+    assert merged["latency_hist"]["count"] == 7
+    # the tail face: --fleet folds the same blocks into the summary
+    t = tail_summary(run, fleet=True)
+    assert t["processes"]["replica-1"]["serve"]["responses"] == 4
+    assert t["merged"]["latency_hist"]["count"] == 7
+    # without the flag the summary stays single-process shaped
+    assert "processes" not in tail_summary(run)
+    # the flag must not be confusable with the fleet_* COUNTER block
+    # (a local once shadowed the parameter): a supervisor heartbeat
+    # carrying fleet_* keys must not force aggregation with the flag
+    # off, and a heartbeat WITHOUT them (an elastic coordinator's) must
+    # not suppress it with the flag on
+    with open(os.path.join(run, "heartbeat.json"), "w") as f:
+        json.dump({"time": time.time(), "pid": os.getpid(), "step": 0,
+                   "fleet_requests": 7, "fleet_responses": 7}, f)
+    assert "processes" not in tail_summary(run)          # flag off
+    with open(os.path.join(run, "heartbeat.json"), "w") as f:
+        json.dump({"time": time.time(), "pid": os.getpid(), "step": 0,
+                   "elastic_generation": 1}, f)
+    assert "processes" in tail_summary(run, fleet=True)  # flag on
+
+
+# --------------------------------------------------------- /metrics HTTP
+
+
+def test_serve_metrics_endpoint_matches_engine_counters(rng, tmp_path):
+    """/metrics consistency pin over the fake executor: the Prometheus
+    scrape equals the engine's live counters — requests, responses, and
+    the histogram total — and the SLO block rides along."""
+    from deepof_tpu.serve.server import build_server
+
+    cfg = _serve_cfg(tmp_path, slo_ms=0.5, budget=0.001)  # everything
+    #   slower than 0.5 ms breaches: the fake 2 ms executor exhausts it
+    eng = InferenceEngine(cfg, forward_fn=make_fake_forward(2.0))
+    httpd = build_server(cfg, eng)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    wait_for_listen("127.0.0.1", port)
+    try:
+        futs = [eng.submit(*_pair(rng)) for _ in range(10)]
+        for f in futs:
+            f.result(timeout=30)
+        stats = eng.stats()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            parsed = parse_prometheus(resp.read().decode())
+        finally:
+            conn.close()
+        assert parsed["deepof_serve_requests"] == stats["serve_requests"]
+        assert parsed["deepof_serve_responses"] == 10
+        # server-side failure count rides the scrape (0 here: the fake
+        # executor never fails) — distinguishable from client errors
+        assert parsed["deepof_serve_server_errors"] == 0
+        assert parsed['deepof_serve_latency_ms_bucket{le="+Inf"}'] == 10
+        assert parsed["deepof_serve_latency_ms_count"] == 10
+        # SLO layer surfaced on the same scrape (and exhausted: the
+        # fake executor cannot beat a 0.5 ms target)
+        assert parsed['deepof_serve_slo{key="exhausted"}'] == 1
+        assert stats["serve_slo"]["exhausted"] is True
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+
+
+def test_start_metrics_server_coordinator_face():
+    """The standalone /metrics endpoint (the elastic coordinator's):
+    Prometheus on /metrics, JSON on /healthz, 500 on a stats failure."""
+    calls = {"n": 0}
+
+    def stats():
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("boom")
+        return {"elastic_generation": 2, "elastic_reforms": 1}
+
+    srv = start_metrics_server(stats)
+    port = srv.server_address[1]
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read().decode()
+            assert parse_prometheus(body)["deepof_elastic_generation"] == 2
+            conn.request("GET", "/healthz")
+            assert json.loads(conn.getresponse().read())[
+                "elastic_reforms"] == 1
+            conn.request("GET", "/metrics")  # the injected stats failure
+            resp = conn.getresponse()
+            assert resp.status == 500
+            assert json.loads(resp.read())["error"] == "stats_failed"
+        finally:
+            conn.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_tail_exits_6_on_exhausted_slo_budget(tmp_path, capsys):
+    from deepof_tpu.cli import main as cli_main
+
+    run = tmp_path / "slo"
+    run.mkdir()
+    (run / "metrics.jsonl").write_text("")
+    h = LatencyHistogram()
+    h.observe(5.0)
+    (run / "heartbeat.json").write_text(json.dumps({
+        "time": time.time(), "pid": os.getpid(), "step": 0,
+        "serve_requests": 100, "serve_responses": 100,
+        "serve_slo": slo_state(h.snapshot(), 100, 0, 16.0, 0.001)}))
+    rc = cli_main(["tail", "--log-dir", str(run)])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["serve"]["slo"]["exhausted"] is True
+    assert rc == 6
+
+
+# ----------------------------------------------- fleet drill acceptance
+
+
+@pytest.mark.chaos
+def test_fleet_drill_metrics_exactness_and_merged_trace(rng, tmp_path):
+    """ISSUE 9 acceptance: a live 2-replica fleet drill. The router's
+    /metrics histogram bucket counts EXACTLY equal the sum of the
+    replicas' own counts for the same window, and the run dir merges
+    into one trace with >= 3 process tracks and at least one request's
+    spans correlated across router and replica by X-Request-Id."""
+    cv2 = pytest.importorskip("cv2")  # noqa: F841 - request bodies
+    from test_fleet import _fleet_cfg, _flow_body, _get_json, _post, \
+        _start_router
+    from deepof_tpu.serve.fleet import Fleet
+
+    fleet_dir = tmp_path / "fleet"
+    cfg = _fleet_cfg(fleet_dir, max_batch=4, timeout_ms=5.0, exec_ms=3.0)
+    cfg = cfg.replace(obs=dataclasses.replace(cfg.obs, trace=True,
+                                              slo_latency_ms=4096.0))
+    body = _flow_body(rng)
+    tracer = obs_trace.install(obs_trace.Tracer(
+        path=str(fleet_dir / "trace.json"), role="router"))
+    try:
+        with Fleet(cfg, 2) as fleet:
+            fleet.start()
+            fleet.wait_ready(min_ready=2, timeout_s=120)
+            router, httpd, port = _start_router(cfg, fleet)
+            try:
+                statuses = [_post(port, body)[0] for _ in range(16)]
+                assert statuses.count(200) == 16
+                # traffic quiesced: scrape the router and each replica
+                status, metrics_text = _get_json_text(port, "/metrics")
+                assert status == 200
+                parsed = parse_prometheus(metrics_text)
+                replica_hists = []
+                for r in fleet.ready_replicas():
+                    hstat, health = _get_json(r.port, "/healthz")
+                    assert hstat == 200
+                    replica_hists.append(health["serve_latency_hist"])
+                expect = merge_hists(replica_hists)
+                cum = 0
+                for bound, count in zip(expect["buckets_ms"],
+                                        expect["counts"]):
+                    cum += count
+                    key = (f'deepof_serve_latency_ms_bucket'
+                           f'{{le="{_fmt_bound(bound)}"}}')
+                    assert parsed[key] == cum, key
+                assert parsed[
+                    'deepof_serve_latency_ms_bucket{le="+Inf"}'] == 16
+                assert parsed["deepof_serve_latency_ms_count"] == 16
+                assert parsed["deepof_serve_responses"] == 16
+                # both replicas actually served (affinity map is exercised
+                # by test_fleet; here we only need multi-process traces)
+                assert parsed["deepof_fleet_responses"] == 16
+                # SLO block on the same scrape (healthy: 4 s target)
+                assert parsed['deepof_fleet_slo{key="exhausted"}'] == 0
+            finally:
+                router.draining = True
+                httpd.shutdown()
+                httpd.server_close()
+        # fleet closed: replicas drained gracefully and flushed traces
+    finally:
+        obs_trace.uninstall()
+        tracer.flush()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if all(os.path.exists(str(fleet_dir / f"replica-{i}" /
+                                  "trace.json")) for i in range(2)):
+            break
+        time.sleep(0.2)
+    summary = aggregate.aggregate_run(str(fleet_dir))
+    names = [p["name"] for p in summary["processes"]]
+    assert len(names) >= 3 and "router" in names
+    assert {"replica-0", "replica-1"} <= set(names)
+    assert summary["requests_correlated"] >= 1
+    # the correlated ids are the router's X-Request-Ids (pid-stamped)
+    payload = json.load(open(summary["path"]))
+    rids = {e["id"] for e in payload["traceEvents"]
+            if e.get("ph") in ("s", "t", "f")}
+    assert any(str(r).startswith("r") for r in rids)
+
+
+def _fmt_bound(bound: float) -> str:
+    f = float(bound)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _get_json_text(port, path, timeout=20.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()

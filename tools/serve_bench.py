@@ -330,6 +330,31 @@ def _drive_closed_loop(port: int, body: bytes, requests: int,
     return time.perf_counter() - t0, sum(ok_count), sum(err_count)
 
 
+def _scrape_metrics(port: int) -> dict:
+    """GET /metrics on the router and read the fleet-aggregated samples
+    back through the Prometheus parser — the bench figures come off the
+    SAME scrape path an operator's collector uses, so the bench and the
+    live counters cannot drift apart silently."""
+    import http.client
+
+    from deepof_tpu.obs.export import parse_prometheus
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        samples = parse_prometheus(conn.getresponse().read().decode())
+    finally:
+        conn.close()
+    return {
+        "fleet_requests": samples.get("deepof_fleet_requests"),
+        "fleet_responses": samples.get("deepof_fleet_responses"),
+        "serve_responses": samples.get("deepof_serve_responses"),
+        "serve_latency_count": samples.get("deepof_serve_latency_ms_count"),
+        "serve_latency_sum_ms": samples.get("deepof_serve_latency_ms_sum"),
+        "fleet_latency_count": samples.get("deepof_fleet_latency_ms_count"),
+    }
+
+
 def _run_fleet_once(cfg, replicas: int, body: bytes, requests: int,
                     clients: int) -> dict:
     from deepof_tpu.serve.fleet import Fleet
@@ -343,15 +368,21 @@ def _run_fleet_once(cfg, replicas: int, body: bytes, requests: int,
         httpd = build_router_server(cfg, router)
         thread = threading.Thread(target=httpd.serve_forever, daemon=True)
         thread.start()
+        scrape = None
         try:
             port = httpd.server_address[1]
             wall, ok, err = _drive_closed_loop(port, body, requests, clients)
+            try:
+                scrape = _scrape_metrics(port)
+            except Exception:  # noqa: BLE001 - the scrape must not fail the bench
+                scrape = None
         finally:
             router.draining = True
             httpd.shutdown()
             httpd.server_close()
         stats = {**fleet.stats(), **router.stats()}
-    return {"wall_s": wall, "ok": ok, "errors": err, "stats": stats}
+    return {"wall_s": wall, "ok": ok, "errors": err, "stats": stats,
+            "scrape": scrape}
 
 
 def fleet_bench(replicas: int = 2, requests: int = 96, clients: int = 8,
@@ -397,6 +428,9 @@ def fleet_bench(replicas: int = 2, requests: int = 96, clients: int = 8,
         "routed": multi["stats"]["fleet_routed"],
         "max_batch": max_batch, "timeout_ms": timeout_ms,
         "exec_ms": exec_ms, "bucket": list(bucket), "log_dir": base,
+        # the router's live /metrics scrape at the end of the window —
+        # the bench's request counts, re-read through Prometheus
+        "metrics_scrape": multi["scrape"],
     }
 
 

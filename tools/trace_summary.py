@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Top-N longest spans (and per-name aggregates) from a trace.json.
+"""Top-N longest spans (and per-name aggregates) from a trace.json —
+and, with --merge, the whole fleet's timeline from a run directory.
 
 Companion to the obs/trace.py tracer: when there is no Perfetto at hand
 (headless host, mid-run triage over ssh), this prints the spans that
@@ -7,6 +8,14 @@ dominated the timeline straight from the Chrome trace-event file.
 
     python tools/trace_summary.py /tmp/run/trace.json --top 15
     python tools/trace_summary.py trace.json --name dispatch
+
+--merge drives obs/aggregate.py headlessly over a multi-process run
+dir (fleet replicas / elastic hosts): writes <run>/trace_merged.json
+(Perfetto-loadable, per-process tracks + request-id flow arrows) and
+prints per-process span aggregates plus the slowest request journeys —
+merged traces are inspectable with no viewer at all.
+
+    python tools/trace_summary.py --merge /tmp/fleet_run
 
 Stdlib-only (like the tracer itself): usable next to a live trainer
 without initializing any backend.
@@ -16,8 +25,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def load_events(path: str) -> tuple[list[dict], dict[int, str]]:
@@ -66,15 +79,77 @@ def summarize(spans: list[dict], threads: dict[int, str], top: int,
     return "\n".join(lines)
 
 
+def merge_report(run_dir: str, top: int) -> tuple[str, int]:
+    """(report text, exit code) for --merge: aggregate the run dir's
+    per-process artifacts into one trace and summarize it headlessly."""
+    # imported lazily: plain single-trace mode stays stdlib-only-at-work
+    from deepof_tpu.obs import aggregate
+
+    try:
+        summary = aggregate.aggregate_run(run_dir)
+    except FileNotFoundError as e:
+        return str(e), 1
+    lines = [
+        f"merged {len(summary['processes'])} process(es) -> "
+        f"{summary['path']}",
+        f"{summary['spans']} spans, {summary['flows']} flow events, "
+        f"{summary['request_ids']} request id(s), "
+        f"{summary['requests_correlated']} correlated across processes",
+        "",
+        f"{'process':<28} {'spans':>6} {'markers':>8}",
+    ]
+    for p in summary["processes"]:
+        name = p["name"] + (f" [{p['rel']}]" if p["rel"] else "")
+        lines.append(f"{name:<28} {p['spans']:>6} {p['markers']:>8}")
+
+    table = aggregate.per_process_table(summary["path"])
+    for proc in sorted(table):
+        lines.append("")
+        lines.append(f"-- {proc}")
+        lines.append(f"{'name':<20} {'count':>6} {'total_ms':>10} "
+                     f"{'max_ms':>9}")
+        rows = sorted(table[proc].items(),
+                      key=lambda kv: -kv[1]["total_ms"])
+        for name, row in rows:
+            lines.append(f"{name:<20} {row['count']:>6} "
+                         f"{row['total_ms']:>10.1f} {row['max_ms']:>9.2f}")
+
+    requests = aggregate.per_request_table(summary["path"], limit=top)
+    if requests:
+        lines.append("")
+        lines.append(f"slowest {len(requests)} request journey(s):")
+        for r in requests:
+            hops = " -> ".join(f"{s['process']}:{s['name']}"
+                               f"({s['dur_ms']:.2f}ms)"
+                               for s in r["spans"])
+            lines.append(f"  {r['request_id']} "
+                         f"[{r['processes']} process(es), "
+                         f"{r['total_ms']:.2f}ms] {hops}")
+    return "\n".join(lines), 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="print top-N longest spans from a Chrome trace-event "
-                    "trace.json (obs/trace.py output)")
-    p.add_argument("path", help="trace.json written by the span tracer")
+                    "trace.json (obs/trace.py output), or --merge a "
+                    "multi-process run dir into one fleet trace")
+    p.add_argument("path", nargs="?", default=None,
+                   help="trace.json written by the span tracer")
     p.add_argument("--top", type=int, default=20)
     p.add_argument("--name", default=None,
                    help="restrict the top-N listing to one span name")
+    p.add_argument("--merge", default=None, metavar="RUN_DIR",
+                   help="aggregate every per-process trace/heartbeat/"
+                        "metrics under a run dir into "
+                        "<run_dir>/trace_merged.json and print "
+                        "per-process + per-request-id aggregates")
     args = p.parse_args(argv)
+    if args.merge is not None:
+        report, rc = merge_report(args.merge, args.top)
+        print(report, file=sys.stderr if rc else sys.stdout)
+        return rc
+    if args.path is None:
+        p.error("need a trace.json path (or --merge RUN_DIR)")
     try:
         spans, threads = load_events(args.path)
     except (OSError, ValueError) as e:
