@@ -291,22 +291,27 @@ def aggregate_processes(log_dir: str, now: float | None = None) -> dict | None:
     now = time.time() if now is None else now
     children = {name: _process_summary(d, now) for name, d in dirs.items()}
     merged: dict = {}
-    hists = []
+    # histograms merge PER KEY (request latency and per-session-frame
+    # latency are separate stories); counters sum per key
+    hists: dict[str, list] = {}
     for child in children.values():
         serve = child.get("serve") or {}
-        for k in ("requests", "responses", "errors", "batches"):
+        for k in ("requests", "responses", "errors", "batches",
+                  "sessions_active", "sessions_created", "sessions_frames",
+                  "sessions_steps", "sessions_decode_saved"):
             if isinstance(serve.get(k), (int, float)):
                 merged[k] = merged.get(k, 0) + serve[k]
-        hist = serve.get("latency_hist")
-        if hist:
-            hists.append(hist)
+        for k, v in serve.items():
+            if k.endswith("latency_hist") and v:
+                hists.setdefault(k, []).append(v)
     if hists:
         from .obs.export import merge_hists  # stdlib-only import chain
 
-        try:
-            merged["latency_hist"] = merge_hists(hists)
-        except ValueError:
-            pass  # foreign/old-format snapshot: skip, never crash tail
+        for k, hs in hists.items():
+            try:
+                merged[k] = merge_hists(hs)
+            except ValueError:
+                pass  # foreign/old-format snapshot: skip, never crash tail
     out = {"processes": children}
     if merged:
         out["merged"] = merged

@@ -81,6 +81,13 @@ def _build_cfg(args) -> ExperimentConfig:
             gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
             train=dataclasses.replace(cfg.train, eval_batch_size=8,
                                       eval_amplifier=1.0))
+    # serve session sugar: the flags ride the same nested-override path
+    # as --set (and before it, so an explicit --set still wins)
+    for flag, dotted in (("session_ttl", "serve.session.ttl_s"),
+                        ("session_max", "serve.session.max_sessions")):
+        value = getattr(args, flag, None)
+        if value is not None:
+            cfg = _apply_override(cfg, dotted, repr(value))
     for item in args.set or []:
         if "=" not in item:
             raise SystemExit(f"bad --set {item!r}: use section.field=value")
@@ -191,7 +198,9 @@ def main(argv=None) -> int:
         "serve", help="inference serving (DESIGN.md \"Serving\"): dynamic "
                       "micro-batching engine over the latest verified "
                       "checkpoint. Default: stdlib HTTP server (POST "
-                      "/v1/flow, GET /healthz) with a serve heartbeat in "
+                      "/v1/flow; streaming video sessions on POST "
+                      "/v1/flow/stream — one decode per frame; GET "
+                      "/healthz) with a serve heartbeat in "
                       "--log-dir; with --input: offline high-throughput "
                       "directory/video inference to --out")
     _add_common(p_srv)
@@ -202,6 +211,19 @@ def main(argv=None) -> int:
                        help="offline mode: output directory for "
                             ".flo/.png results")
     p_srv.add_argument("--no-png", action="store_true")
+    p_srv.add_argument("--session-ttl", type=float, default=None,
+                       metavar="SECONDS",
+                       help="streaming sessions (POST /v1/flow/stream, "
+                            "DESIGN.md \"Streaming sessions\"): idle TTL "
+                            "before a session expires — shorthand for "
+                            "--set serve.session.ttl_s=X; <= 0 disables "
+                            "the TTL")
+    p_srv.add_argument("--session-max", type=int, default=None,
+                       metavar="N",
+                       help="streaming sessions: LRU bound on "
+                            "concurrently kept sessions per engine — "
+                            "shorthand for "
+                            "--set serve.session.max_sessions=N")
     p_srv.add_argument("--replicas", type=int, default=None,
                        help="self-healing serving fleet (DESIGN.md "
                             "\"Fleet\"): supervise N engine-replica "

@@ -365,6 +365,30 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class SessionConfig:
+    """Streaming video sessions (serve/session.py, DESIGN.md "Streaming
+    sessions"): a bounded per-session cache of the last frame's decoded +
+    bucket-preprocessed tensor, so `POST /v1/flow/stream` with ONE new
+    frame forms the (prev, next) pair server-side — one decode and one
+    preprocess per frame instead of two for a client walking a video.
+    Sessions end explicitly (DELETE), by idle TTL (the sweeper), or by
+    LRU pressure; every eviction is a structured `session_expired` error
+    on the session's next use, never a silent drop."""
+
+    # LRU bound on concurrently kept sessions per engine (each holds one
+    # bucket-resolution float32 frame: ~H*W*12 bytes). The oldest-used
+    # session past the bound is evicted with a tombstone.
+    max_sessions: int = 256
+    # idle TTL: a session untouched this long is expired by the sweeper
+    # (and exactly on access, whichever comes first). <= 0 disables TTL
+    # (sessions live until DELETE or LRU pressure).
+    ttl_s: float = 120.0
+    # sweeper-thread cadence; <= 0 disables the background sweep (TTL is
+    # then enforced only lazily on access)
+    sweep_s: float = 5.0
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving subsystem (deepof_tpu/serve/, DESIGN.md
     "Serving"): the dynamic micro-batching engine, the shape-bucket
@@ -415,6 +439,10 @@ class ServeConfig:
     # This is how fleet tests and `serve_bench --fleet` run replica
     # subprocesses cheaply; None = the real restored model.
     fake_exec_ms: float | None = None
+    # Streaming video sessions (serve/session.py): POST /v1/flow/stream
+    # keeps the last frame per session so consecutive pairs cost one
+    # decode, not two; the router pins each session to one replica.
+    session: SessionConfig = field(default_factory=SessionConfig)
     # Self-healing replica fleet (serve/fleet.py); replicas=0 keeps the
     # single-process serve path.
     fleet: FleetConfig = field(default_factory=FleetConfig)

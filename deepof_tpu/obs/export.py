@@ -88,6 +88,28 @@ class LatencyHistogram:
                     "count": self._count}
 
 
+def percentile_ms(hist: dict | None, frac: float) -> float | None:
+    """Approximate percentile from a fixed-bucket snapshot: the upper
+    bound of the bucket holding the quantile rank (the same answer at
+    every aggregation level, because the buckets are fixed by contract —
+    unlike a deque-based percentile, this one survives an exact merge).
+    None on an empty/absent histogram; observations in the +Inf bucket
+    report the largest finite bound (the histogram cannot say more)."""
+    if not is_hist_snapshot(hist):
+        return None
+    total = sum(int(c) for c in hist["counts"])
+    if total <= 0:
+        return None
+    rank = max(min(float(frac), 1.0), 0.0) * (total - 1)
+    cum = 0
+    for i, c in enumerate(hist["counts"]):
+        cum += int(c)
+        if cum > rank:
+            bounds = hist["buckets_ms"]
+            return float(bounds[min(i, len(bounds) - 1)])
+    return float(hist["buckets_ms"][-1])
+
+
 def is_hist_snapshot(value) -> bool:
     return (isinstance(value, dict) and "counts" in value
             and "buckets_ms" in value)

@@ -105,6 +105,11 @@ class Heartbeat:
         self._tracer = tracer
         self._on_wedge = on_wedge
         self._lock = threading.Lock()
+        # serializes file writes: touch(flush=True) writes from the
+        # CALLING thread, racing the background writer — both use the
+        # same pid-derived tmp path, and an interleaved truncate/write
+        # could promote torn JSON into heartbeat.json via os.replace
+        self._write_lock = threading.Lock()
         self._durs: deque = deque(maxlen=max(int(window), 4))
         self._last_activity = time.monotonic()
         self._beats = 0
@@ -140,12 +145,24 @@ class Heartbeat:
             self._last_step = int(step)
             self._wedge_active = False
 
-    def touch(self) -> None:
+    def touch(self, flush: bool = False) -> None:
         """Activity that is not a step (eval, checkpoint, rollback):
-        resets the wedge clock without entering the step-time estimate."""
+        resets the wedge clock without entering the step-time estimate.
+
+        flush=True additionally rewrites heartbeat.json NOW, from the
+        calling thread. Use it when entering a long GIL-bound phase (the
+        eval-executable XLA lowering/trace is pure Python): on a
+        contended host the background writer thread can starve for the
+        whole phase, so an external supervisor reading the file
+        (fleet/elastic `host_verdict`) would see a stale timestamp and
+        evict a healthy host. A synchronous write on entry hands the
+        supervisor the full `stale_after_s` window measured FROM the
+        phase start — the in-loop cadence cannot guarantee that."""
         with self._lock:
             self._last_activity = time.monotonic()
             self._wedge_active = False
+        if flush:
+            self._write()
 
     # ----------------------------------------------------------- sampling
     def _snapshot(self) -> dict:
@@ -173,17 +190,18 @@ class Heartbeat:
         return rec
 
     def _write(self) -> None:
-        rec = self._snapshot()
-        try:
-            d = os.path.dirname(os.path.abspath(self.path))
-            os.makedirs(d, exist_ok=True)
-            tmp = os.path.join(
-                d, f".{os.path.basename(self.path)}.tmp.{os.getpid()}")
-            with open(tmp, "w") as f:
-                json.dump(rec, f)
-            os.replace(tmp, self.path)  # readers never see a torn file
-        except OSError:
-            pass  # read-only tree must not crash the heartbeat thread
+        with self._write_lock:  # flush-from-caller vs writer thread
+            rec = self._snapshot()
+            try:
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                tmp = os.path.join(
+                    d, f".{os.path.basename(self.path)}.tmp.{os.getpid()}")
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                os.replace(tmp, self.path)  # readers never see a torn file
+            except OSError:
+                pass  # read-only tree must not crash the heartbeat thread
 
     # ----------------------------------------------------------- watchdog
     def _check_wedge(self) -> None:

@@ -58,18 +58,29 @@ def pick_bucket(native_hw: tuple[int, int],
     return buckets[-1]
 
 
-def prepare_pair(src_raw: np.ndarray, tgt_raw: np.ndarray,
-                 bucket: tuple[int, int], mean) -> np.ndarray:
-    """Decoded BGR pair -> one network-input row (H, W, 6) float32 at the
-    bucket resolution: resize + the training preprocess (subtract BGR
-    mean, /255 — `losses/pyramid.py preprocess`, done here in numpy so a
-    corrupt input fails on the submitting thread, before batching)."""
+def prepare_frame(img_raw: np.ndarray, bucket: tuple[int, int],
+                  mean) -> np.ndarray:
+    """ONE decoded BGR frame -> its preprocessed half-row (H, W, 3)
+    float32 at the bucket resolution: resize + the training preprocess
+    (subtract BGR mean, /255 — `losses/pyramid.py preprocess`, done here
+    in numpy so a corrupt input fails on the submitting thread, before
+    batching). The preprocess is per-frame independent, so a network
+    input pair is exactly the channel concatenation of two of these —
+    the property the streaming-session cache (serve/session.py) relies
+    on for bit-identical parity with the pairwise path."""
     from ..data.datasets import _resize
 
     m = np.asarray(mean, np.float32)
-    rows = [((_resize(img, bucket).astype(np.float32) - m) / np.float32(255.0))
-            for img in (src_raw, tgt_raw)]
-    return np.concatenate(rows, axis=-1)
+    return ((_resize(img_raw, bucket).astype(np.float32) - m)
+            / np.float32(255.0))
+
+
+def prepare_pair(src_raw: np.ndarray, tgt_raw: np.ndarray,
+                 bucket: tuple[int, int], mean) -> np.ndarray:
+    """Decoded BGR pair -> one network-input row (H, W, 6) float32 at
+    the bucket resolution (two prepare_frame halves, concatenated)."""
+    return np.concatenate([prepare_frame(img, bucket, mean)
+                           for img in (src_raw, tgt_raw)], axis=-1)
 
 
 def flow_to_native(flow: np.ndarray, cfg: ExperimentConfig,

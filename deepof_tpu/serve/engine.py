@@ -37,10 +37,20 @@ Design decisions that matter:
     (structured `dispatch_failed`) and the batcher keeps serving; a
     per-request postprocess failure fails only that request.
 
+  - Streaming video sessions (serve/session.py): `submit_next(session,
+    frame)` keeps the last frame's preprocessed half-row per session and
+    forms the (prev, next) pair server-side — one decode + one
+    preprocess per frame instead of two for the video walk, with
+    bitwise-identical flow to the pairwise path (prepare_pair is the
+    concat of two per-frame preprocesses). The store is LRU + TTL
+    bounded; a dead session's next frame is a structured
+    `session_expired` the client re-primes from.
+
 Observability: trace spans (serve_enqueue / serve_batch /
-serve_dispatch / serve_postprocess) on the shared obs tracer, and a
-`serve_*` counter block (queue depth, batch occupancy, p50/p99 latency,
-requests/s) exposed via stats()/heartbeat_sample() for the serve
+serve_dispatch / serve_postprocess, session_prime / session_step) on
+the shared obs tracer, and a `serve_*` counter block (queue depth,
+batch occupancy, p50/p99 latency, requests/s, the serve_sessions_*
+streaming axis) exposed via stats()/heartbeat_sample() for the serve
 heartbeat and `deepof_tpu tail`.
 """
 
@@ -58,9 +68,12 @@ import numpy as np
 
 from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
-from ..obs.export import LatencyHistogram, slo_state, validate_slo
-from .buckets import flow_to_native, pick_bucket, prepare_pair, resolve_buckets
+from ..obs.export import (LatencyHistogram, percentile_ms, slo_state,
+                          validate_slo)
+from .buckets import (flow_to_native, pick_bucket, prepare_frame,
+                      prepare_pair, resolve_buckets)
 from .quant import dequantize_params, quantize_params, resolve_precisions
+from .session import SessionExpired, SessionStore
 
 _STOP = object()
 
@@ -75,7 +88,9 @@ class ServeError(RuntimeError):
     human-readable message, JSON-ready via payload(). Codes:
     bad_input (decode/preprocess), dispatch_failed (the batched forward
     raised — the whole flush fails), postprocess_failed (one request's
-    resize/rescale raised), engine_closed, bad_request (server-side)."""
+    resize/rescale raised), engine_closed, bad_request (server-side),
+    session_expired (a streaming session was TTL-expired or LRU-evicted
+    — the client re-primes; serve/session.py)."""
 
     def __init__(self, code: str, message: str,
                  request_id: int | str | None = None):
@@ -92,9 +107,10 @@ class ServeError(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "bucket", "tier", "native_hw", "future", "t_enq",
-                 "rid")
+                 "rid", "session", "frame_index")
 
-    def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid):
+    def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid,
+                 session=None, frame_index=None):
         self.x = x
         self.bucket = bucket
         self.tier = tier
@@ -102,6 +118,11 @@ class _Request:
         self.future = future
         self.t_enq = t_enq
         self.rid = rid
+        # streaming-session step provenance (serve/session.py): the
+        # session id + 0-based frame index, echoed in the response and
+        # observed into the per-session-frame latency histogram
+        self.session = session
+        self.frame_index = frame_index
 
     @property
     def key(self) -> tuple[tuple[int, int], str]:
@@ -301,6 +322,13 @@ class InferenceEngine:
         # /metrics face of the latency story — fixed log-spaced buckets,
         # so replica histograms merge EXACTLY at the router
         self._hist = LatencyHistogram()
+        # streaming sessions (serve/session.py): last-frame cache +
+        # a second fixed-bucket histogram for per-session-frame latency
+        # (merges exactly at the router, separately from serve_latency)
+        sc = cfg.serve.session
+        self.sessions = SessionStore(max_sessions=sc.max_sessions,
+                                     ttl_s=sc.ttl_s, sweep_s=sc.sweep_s)
+        self._session_hist = LatencyHistogram()
         # per-second completion buckets for requests/s — unlike reusing
         # the latency deque, this can't clamp the rate at high load
         self._done_per_s: dict[int, int] = {}
@@ -401,6 +429,82 @@ class InferenceEngine:
             self._fail(fut, e)
         return fut
 
+    def submit_next(self, session: str, frame,
+                    precision: str | None = None,
+                    request_id: int | str | None = None) -> Future:
+        """Advance a streaming session by ONE frame (serve/session.py).
+
+        The first frame of a session primes it: the future resolves
+        immediately with {"primed": True, "session", "bucket",
+        "native_hw", "frames", "request_id"} — nothing dispatches.
+        Every later frame forms the (prev, next) pair from the cached
+        previous frame — one decode + one preprocess instead of two —
+        and resolves like submit(), plus {"session", "frame_index"}.
+
+        Failure contract: a frame for a TTL-expired or LRU-evicted
+        session fails with a structured `session_expired` ServeError
+        (the client re-primes by resending — that retry is counted as
+        `resumed`); a mid-session resolution change re-primes in place
+        (a fresh `primed` reply, counted as `rebucketed`). A decode
+        failure fails this frame only and does NOT advance the session.
+        """
+        rid = request_id if request_id is not None else next(self._rid)
+        fut: Future = Future()
+        counted = False  # one _requests tick per frame, on ANY path
+        # span name is a fast pre-probe; advance() is the authority (a
+        # race with the sweeper at most mislabels one span's name)
+        kind_hint = "session_step" if self.sessions.contains(session) \
+            else "session_prime"
+        try:
+            tier = self._resolve_tier(precision, rid)
+            with obs_trace.span(kind_hint, session=str(session),
+                                request_id=rid) as span:
+                img = self._decode(frame)
+                native_hw = (int(img.shape[0]), int(img.shape[1]))
+                bucket = pick_bucket(native_hw, self.buckets)
+                row = prepare_frame(img, bucket, self.mean)
+                try:
+                    out = self.sessions.advance(str(session), row, bucket,
+                                                native_hw, tier)
+                except SessionExpired as e:
+                    raise ServeError(
+                        "session_expired",
+                        f"session {e.sid!r} {e.reason} — resend the frame "
+                        f"to re-prime", rid)
+                if out[0] == "primed":
+                    _, s = out
+                    span.set(kind="session_prime")
+                    fut.set_result({"primed": True, "session": s.sid,
+                                    "bucket": bucket,
+                                    "native_hw": native_hw,
+                                    "frames": s.frames,
+                                    "request_id": rid})
+                    return fut
+                _, prev_row, s = out
+                span.set(kind="session_step", frame_index=s.frames - 1)
+                x = np.concatenate([prev_row, row], axis=-1)
+            with self._stats_lock:
+                self._requests += 1
+                self._requests_by_tier[tier] += 1
+            counted = True
+            self._enqueue(_Request(x, bucket, tier, native_hw, fut,
+                                   time.monotonic(), rid,
+                                   session=s.sid,
+                                   frame_index=s.frames - 1))
+        except ServeError as e:
+            e.request_id = e.request_id or rid
+            if not counted:  # failed frames stay ledgered, exactly once
+                with self._stats_lock:
+                    self._requests += 1
+            self._fail(fut, e)
+        except Exception as e:  # noqa: BLE001 - decode errors are per-request
+            if not counted:
+                with self._stats_lock:
+                    self._requests += 1
+            self._fail(fut, ServeError(
+                "bad_input", f"{type(e).__name__}: {e}", rid))
+        return fut
+
     def _enqueue(self, req: _Request) -> None:
         with self._stats_lock:
             if self._closed:
@@ -431,7 +535,11 @@ class InferenceEngine:
     def _fail(self, fut: Future, err: ServeError) -> None:
         with self._stats_lock:
             self._errors += 1
-            if err.code not in ("bad_input", "bad_request"):
+            # session_expired is protocol, not failure: the client let
+            # its session idle past the TTL (or lost an LRU race) and
+            # re-primes — it must not burn the operator's SLO budget
+            if err.code not in ("bad_input", "bad_request",
+                                "session_expired"):
                 self._server_errors += 1  # burns the SLO error budget
         fut.set_exception(err)
 
@@ -522,6 +630,10 @@ class InferenceEngine:
                     continue
                 done = time.monotonic()
                 self._hist.observe(done - r.t_enq)
+                if r.session is not None:
+                    # per-session-frame latency: the streaming axis's own
+                    # histogram (submit -> flow for ONE new frame)
+                    self._session_hist.observe(done - r.t_enq)
                 with self._stats_lock:
                     self._responses += 1
                     self._responses_by_tier[r.tier] += 1
@@ -532,11 +644,14 @@ class InferenceEngine:
                         for old in [s for s in self._done_per_s
                                     if s < sec - _RATE_WINDOW_S - 1]:
                             del self._done_per_s[old]
-                r.future.set_result({"flow": flow, "bucket": bucket,
-                                     "precision": tier,
-                                     "native_hw": r.native_hw,
-                                     "latency_s": done - r.t_enq,
-                                     "request_id": r.rid})
+                result = {"flow": flow, "bucket": bucket,
+                          "precision": tier, "native_hw": r.native_hw,
+                          "latency_s": done - r.t_enq,
+                          "request_id": r.rid}
+                if r.session is not None:
+                    result["session"] = r.session
+                    result["frame_index"] = r.frame_index
+                r.future.set_result(result)
         with self._stats_lock:
             self._batches += 1
             self._occupancy_sum += n
@@ -638,6 +753,15 @@ class InferenceEngine:
             out["serve_latency_p50_ms"] = None
             out["serve_latency_p99_ms"] = None
         out["serve_requests_per_s"] = round(recent / _RATE_WINDOW_S, 3)
+        # streaming sessions: the serve_sessions_* block + the per-
+        # session-frame latency histogram (p50/p99 read off the fixed
+        # buckets — obs/export.py percentile_ms — so the figure an
+        # operator sees here matches what a fleet-level merge would say)
+        out.update(self.sessions.stats())
+        shist = self._session_hist.snapshot()
+        out["serve_session_latency_hist"] = shist
+        out["serve_session_latency_p50_ms"] = percentile_ms(shist, 0.50)
+        out["serve_session_latency_p99_ms"] = percentile_ms(shist, 0.99)
         # fixed-bucket histogram + SLO state (obs/export.py): the
         # scrapeable /metrics face; replica histograms merge exactly at
         # the router because the buckets are fixed by contract
@@ -664,6 +788,7 @@ class InferenceEngine:
             if self._closed:
                 return
             self._closed = True
+        self.sessions.close()  # stop the TTL sweeper thread
         # drains in order: queued work still serves. The put can block on
         # a full queue only until the batcher frees a slot (it is still
         # consuming at this point).

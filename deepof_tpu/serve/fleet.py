@@ -34,7 +34,15 @@ Replicas inherit the supervisor's exact serve ladder INCLUDING the
 precision tiers (`serve.precisions` round-trips through the replica
 config.json), so every replica can serve every (bucket, tier) pair
 while the router concentrates each pair's traffic on its affinity
-replica (serve/router.py folds the tier into the affinity map).
+replica (serve/router.py folds the tier into the affinity map). The
+streaming-session knobs (`serve.session.*`, serve/session.py) round-trip
+the same way: every replica runs the same session TTL/LRU bounds the
+router's sticky map mirrors, so a session pinned to a replica expires at
+the front and at the back on the same clock. Session state is
+deliberately replica-local — an evicted or crashed replica takes its
+sessions with it, and the router demotes those to structured
+`session_lost` replies (clients re-prime on a healthy replica) instead
+of migrating state across processes.
 
 `run_fleet` is the `serve --replicas N` entry: fleet + front router
 (serve/router.py) + a fleet heartbeat whose `fleet_*` counter block

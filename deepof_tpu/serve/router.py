@@ -38,6 +38,21 @@ Three policies, in order:
   poll period. A request that exhausts its candidates gets a structured
   502 — every admitted request resolves to a response or a structured
   error, never silence.
+
+  Session affinity (the one deliberate exception to statelessness).
+  `POST /v1/flow/stream` frames (serve/session.py) are pinned: a sticky
+  session -> replica map routes every frame of a session to the replica
+  holding its cached previous frame (new sessions fall back to the
+  bucket-affinity ladder, probing the body's "frame" image, and are
+  pinned where their first frame lands). Sticky steps do NOT failover —
+  a sibling has no cached frame, so replaying there would silently
+  re-prime mid-stream. Instead, a lost pinned replica (transport error
+  or 5xx) demotes to a structured 410 `session_lost` the client
+  re-primes from: requests stay pure at the fleet level, there is no
+  cross-replica session-state migration. The sticky map is bounded
+  (serve.session.max_sessions x fleet size, LRU) and TTL-aged like the
+  replica stores it mirrors; `fleet_session_*` counters surface the
+  whole axis.
 """
 
 from __future__ import annotations
@@ -50,7 +65,7 @@ import os
 import struct
 import threading
 import time
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Callable
 
 from ..core.config import ExperimentConfig
@@ -156,6 +171,20 @@ class Router:
         # counter) to chain one request's spans across processes in the
         # merged fleet trace
         self._rid_seq = itertools.count(1)
+        # sticky session -> (replica idx, last monotonic) map
+        # (serve/session.py): bounded LRU mirroring the replicas' own
+        # session stores — per-replica capacity x fleet size, aged by
+        # the same TTL, so the front can never pin more sessions than
+        # the fleet can hold
+        self._sticky: OrderedDict[str, tuple[int, float]] = OrderedDict()
+        self._sticky_cap = (max(int(cfg.serve.session.max_sessions), 1)
+                            * max(self.fleet.size, 1))
+        self._sticky_ttl = float(cfg.serve.session.ttl_s)
+        self._session_primes = 0   # sessions pinned (first frame routed)
+        self._session_steps = 0    # frames routed via the sticky map
+        self._sessions_lost = 0    # pinned replica gone -> 410 session_lost
+        self._session_evicted = 0  # sticky-map LRU drops
+        self._session_expired = 0  # sticky-map TTL drops
 
     # ---------------------------------------------------------- routing
     def _preferred(self, key) -> int:
@@ -207,7 +236,7 @@ class Router:
             self._in_flight[idx] -= 1
 
     def _proxy(self, replica, path: str, body: bytes, ctype: str,
-               request_id: str | None = None):
+               request_id: str | None = None, method: str = "POST"):
         conn = http.client.HTTPConnection(self.fleet.host, replica.port,
                                           timeout=self.timeout_s)
         headers = {"Content-Type": ctype or "application/json"}
@@ -216,32 +245,80 @@ class Router:
             # fleet trace chains router -> replica per request
             headers["X-Request-Id"] = request_id
         try:
-            conn.request("POST", path, body, headers)
+            conn.request(method, path, body, headers)
             resp = conn.getresponse()
             return (resp.status, resp.read(),
                     resp.getheader("Content-Type") or "application/json")
         finally:
             conn.close()
 
-    def route_key(self, body: bytes):
-        """Best-effort affinity (bucket, tier) for a /v1/flow body:
-        header-probe the 'prev' image's dimensions without decoding it,
-        and read the declared `precision` (an unknown tier routes as
-        the default — the replica produces the structured 400, not the
+    # ---------------------------------------------------- sticky sessions
+    def _sticky_get(self, sid: str) -> int | None:
+        """The session's pinned replica index, refreshing its LRU/TTL
+        standing; None when unpinned (or aged out — counted)."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._sticky.get(sid)
+            if entry is None:
+                return None
+            idx, last = entry
+            if self._sticky_ttl > 0 and now - last > self._sticky_ttl:
+                # the replica's own store expired it too (same TTL):
+                # route fresh, let the replica answer with its tombstone
+                del self._sticky[sid]
+                self._session_expired += 1
+                return None
+            self._sticky[sid] = (idx, now)
+            self._sticky.move_to_end(sid)
+            return idx
+
+    def _sticky_put(self, sid: str, idx: int) -> None:
+        with self._lock:
+            fresh = sid not in self._sticky
+            self._sticky[sid] = (idx, time.monotonic())
+            self._sticky.move_to_end(sid)
+            if fresh:
+                self._session_primes += 1
+            while len(self._sticky) > self._sticky_cap:
+                self._sticky.popitem(last=False)
+                self._session_evicted += 1
+
+    def _sticky_drop(self, sid: str) -> None:
+        with self._lock:
+            self._sticky.pop(sid, None)
+
+    @staticmethod
+    def _is_stream(path: str) -> bool:
+        return path.rstrip("/").endswith("/stream")
+
+    @staticmethod
+    def _body_json(body: bytes) -> dict | None:
+        try:
+            req = json.loads(body)
+        except Exception:  # noqa: BLE001 - the replica owns the 400
+            return None
+        return req if isinstance(req, dict) else None
+
+    def _key_from(self, req: dict | None, image_field: str = "prev"):
+        """Best-effort affinity (bucket, tier) from a parsed body:
+        header-probe the image's dimensions without decoding it, and
+        read the declared `precision` (an unknown tier routes as the
+        default — the replica produces the structured 400, not the
         front)."""
+        if req is None:
+            return None
         bucket = None
         tier = self.tiers[0]
         try:
-            req = json.loads(body)
             p = req.get("precision")
             if p in self.tiers:
                 tier = p
-            prev_b64 = req.get("prev", "")
-            if prev_b64:
+            img_b64 = req.get(image_field, "")
+            if img_b64:
                 # the first ~KB of image bytes holds every header we
                 # parse; 4096 is 4-aligned, so a truncated prefix still
                 # decodes
-                raw = base64.b64decode(prev_b64[:4096])
+                raw = base64.b64decode(img_b64[:4096])
                 hw = probe_image_hw(raw)
                 if hw:
                     bucket = pick_bucket(hw, self.buckets)
@@ -249,10 +326,19 @@ class Router:
             return None
         return (bucket, tier) if bucket is not None else None
 
+    def route_key(self, body: bytes):
+        """Best-effort affinity (bucket, tier) for a /v1/flow body (the
+        pre-session entry point; _route parses once and calls _key_from
+        directly)."""
+        return self._key_from(self._body_json(body))
+
     def handle_flow(self, path: str, body: bytes,
                     ctype: str) -> tuple[int, bytes, str]:
-        """Route one POST /v1/flow: returns (status, payload, ctype) —
-        always; a request admitted here cannot be silently dropped.
+        """Route one POST /v1/flow or /v1/flow/stream: returns (status,
+        payload, ctype) — always; a request admitted here cannot be
+        silently dropped. Stream frames with a pinned session route
+        sticky (no failover — see _route_pinned); everything else walks
+        the affinity ladder with failover replay.
         Every admitted request gets an X-Request-Id (router pid + seq)
         stamped downstream, a `route` span on the router's tracer, and
         a front-door latency observation on success."""
@@ -267,7 +353,20 @@ class Router:
 
     def _route(self, path: str, body: bytes, ctype: str, rid: str,
                t0: float, span) -> tuple[int, bytes, str]:
-        key = self.route_key(body)
+        req = self._body_json(body)
+        sid = None
+        if self._is_stream(path) and req is not None:
+            s = req.get("session")
+            if isinstance(s, str) and s:
+                sid = s
+                pinned = self._sticky_get(sid)
+                if pinned is not None:
+                    # a pinned session's cached frame lives on exactly
+                    # one replica: route there or demote to session_lost
+                    # — never replay on a sibling (it has no state)
+                    return self._route_pinned(path, body, ctype, rid, t0,
+                                              span, sid, pinned)
+        key = self._key_from(req, "frame" if sid is not None else "prev")
         tried: set[int] = set()
         last_error = None
         for attempt in range(self.retries + 1):
@@ -320,6 +419,13 @@ class Router:
                     total = None
             if status < 400:
                 self._hist.observe(time.monotonic() - t0)
+            if sid is not None and (status < 400 or status == 410):
+                # pin the session where its frame actually landed (410
+                # included: the session's tombstone lives THERE, so the
+                # client's re-prime must return to the same replica to
+                # count as a resume). A plain 4xx primed nothing — do
+                # not pin an id the replica rejected
+                self._sticky_put(sid, replica.idx)
             span.set(replica=replica.idx, status=status,
                      attempts=attempt + 1)
             hook = self.beat_hook
@@ -339,6 +445,120 @@ class Router:
                        f"last: {last_error}",
             "attempts": max(len(tried), 1),
         }).encode(), "application/json")
+
+    def _route_pinned(self, path: str, body: bytes, ctype: str, rid: str,
+                      t0: float, span, sid: str,
+                      pinned: int) -> tuple[int, bytes, str]:
+        """One attempt against a session's pinned replica — no failover
+        (a sibling has no cached frame; replaying there would silently
+        re-prime mid-stream). A gone/failing pinned replica demotes to a
+        structured 410 `session_lost` the client re-primes from."""
+        replica = next((r for r in self.fleet.ready_replicas()
+                        if r.idx == pinned), None)
+        if replica is None:
+            return self._session_lost_reply(sid, span,
+                                            "replica not ready")
+        with self._lock:
+            if self._in_flight[replica.idx] >= self.max_in_flight:
+                # the hard cap still holds for pinned traffic: shedding
+                # keeps the session alive (retry-able), unlike demotion
+                self._errors += 1
+                self._server_errors += 1
+                self._shed += 1
+                span.set(outcome="overloaded", session=sid)
+                return (503, json.dumps(
+                    {"error": "overloaded", "session": sid,
+                     "message": "the session's replica is saturated — "
+                                "retry later"}).encode(),
+                    "application/json")
+            self._in_flight[replica.idx] += 1
+            self._routed[replica.idx] += 1
+        try:
+            status, payload, rtype = self._proxy(replica, path, body,
+                                                 ctype, request_id=rid)
+        except Exception as e:  # noqa: BLE001 - transport = session lost
+            self._release(replica.idx)
+            self.fleet.note_failure(replica.idx)
+            return self._session_lost_reply(sid, span,
+                                            f"{type(e).__name__}: {e}")
+        self._release(replica.idx)
+        if status >= 500:
+            self.fleet.note_failure(replica.idx)
+            return self._session_lost_reply(
+                sid, span, payload.decode("utf-8", "replace")[:200])
+        with self._lock:
+            if status == 200:
+                # only a frame that produced flow is a STEP — a 202
+                # re-prime (rebucket) or a relayed 4xx must not drift
+                # this above the sum of replica serve_sessions_steps
+                self._session_steps += 1
+            if status < 400:
+                self._responses += 1
+                total = self._responses
+            else:
+                self._errors += 1  # structured client error, relayed
+                total = None
+        if status < 400:
+            self._hist.observe(time.monotonic() - t0)
+        span.set(replica=replica.idx, status=status, session=sid,
+                 attempts=1)
+        hook = self.beat_hook
+        if total is not None and hook is not None:
+            try:
+                hook(total)
+            except Exception:  # noqa: BLE001 - obs never kills routing
+                pass
+        return status, payload, rtype
+
+    def _session_lost_reply(self, sid: str, span,
+                            detail: str) -> tuple[int, bytes, str]:
+        self._sticky_drop(sid)
+        with self._lock:
+            self._errors += 1
+            self._server_errors += 1
+            self._sessions_lost += 1
+        span.set(outcome="session_lost", session=sid)
+        return (410, json.dumps({
+            "error": "session_lost", "session": sid,
+            "message": f"the session's replica is gone ({detail}); "
+                       "resend the frame to re-prime",
+        }).encode(), "application/json")
+
+    def handle_session_delete(self, path: str) -> tuple[int, bytes, str]:
+        """Route DELETE /v1/flow/stream/<id>: proxy to the pinned
+        replica (dropping the sticky entry either way). An unpinned id
+        is a structured 404; a dead pinned replica still counts as
+        deleted — its state died with it."""
+        # the id is the FULL suffix after the stream prefix (the same
+        # parse server.py uses, so the two frontends cannot disagree;
+        # slash-bearing ids are rejected at POST, this is the backstop)
+        sid = ""
+        for prefix in ("/v1/flow/stream/", "/flow/stream/"):
+            if path.startswith(prefix):
+                sid = path[len(prefix):]
+                break
+        if not sid:  # bare /v1/flow/stream or an unknown path shape
+            return (404, json.dumps({"error": "not_found",
+                                     "message": path}).encode(),
+                    "application/json")
+        pinned = self._sticky_get(sid)
+        if pinned is None:
+            return (404, json.dumps({"error": "session_unknown",
+                                     "session": sid}).encode(),
+                    "application/json")
+        self._sticky_drop(sid)
+        replica = next((r for r in self.fleet.ready_replicas()
+                        if r.idx == pinned), None)
+        if replica is not None:
+            try:
+                return self._proxy(replica, path, b"", "application/json",
+                                   method="DELETE")
+            except Exception:  # noqa: BLE001 - replica gone: state gone too
+                self.fleet.note_failure(replica.idx)
+        return (200, json.dumps({"session": sid, "deleted": True,
+                                 "note": "replica gone; session state "
+                                         "died with it"}).encode(),
+                "application/json")
 
     # ------------------------------------------------------------ stats
     def in_flight_total(self) -> int:
@@ -365,6 +585,14 @@ class Router:
                 "fleet_routed": {f"replica-{i}": n
                                  for i, n in sorted(self._routed.items())},
                 "fleet_draining": self.draining,
+                # session-affinity axis (serve/session.py): sticky-map
+                # size + the pin/step/lost ledger `tail` surfaces
+                "fleet_sessions_sticky": len(self._sticky),
+                "fleet_session_primes": self._session_primes,
+                "fleet_session_steps": self._session_steps,
+                "fleet_session_lost": self._sessions_lost,
+                "fleet_session_evicted": self._session_evicted,
+                "fleet_session_expired": self._session_expired,
             }
             requests, failures = self._requests, self._server_errors
         out["fleet_latency_hist"] = hist
@@ -421,7 +649,10 @@ class Router:
         totals: dict = {}
         maxima: dict = {}
         by_tier: dict[str, dict] = defaultdict(lambda: defaultdict(int))
-        hists: list[dict] = []
+        # histograms merge PER KEY: a replica now exports two (request
+        # latency + per-session-frame latency) and folding them together
+        # would corrupt both stories
+        hists: dict[str, list[dict]] = defaultdict(list)
         scraped = failed = 0
         for stats in results:
             if stats is None:
@@ -432,7 +663,7 @@ class Router:
                 if not k.startswith("serve_") or k in self._SCRAPE_SKIP:
                     continue
                 if is_hist_snapshot(v):
-                    hists.append(v)
+                    hists[k].append(v)
                 elif k in ("serve_requests_by_tier",
                            "serve_responses_by_tier") \
                         and isinstance(v, dict):
@@ -450,8 +681,8 @@ class Router:
                     totals[k] = totals.get(k, 0) + v
         out = {**totals, **maxima}
         out.update({k: dict(v) for k, v in by_tier.items()})
-        if hists:
-            out["serve_latency_hist"] = merge_hists(hists)
+        for k, hs in hists.items():
+            out[k] = merge_hists(hs)
         out["serve_replicas_scraped"] = scraped
         out["serve_replicas_scrape_failed"] = failed
         return out
@@ -518,7 +749,8 @@ def build_router_server(cfg: ExperimentConfig, router: Router):
                                        "message": self.path})
 
         def do_POST(self):  # noqa: N802
-            if self.path not in ("/v1/flow", "/flow"):
+            if self.path not in ("/v1/flow", "/flow",
+                                 "/v1/flow/stream", "/flow/stream"):
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
                 return
@@ -535,6 +767,19 @@ def build_router_server(cfg: ExperimentConfig, router: Router):
                 return
             status, payload, ctype = router.handle_flow(
                 self.path, body, self.headers.get("Content-Type", ""))
+            self._reply(status, payload, ctype)
+
+        def do_DELETE(self):  # noqa: N802
+            if not self.path.startswith(("/v1/flow/stream/",
+                                         "/flow/stream/")):
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+                return
+            if router.draining:
+                self._reply_json(503, {"error": "draining",
+                                       "message": "fleet is shutting down"})
+                return
+            status, payload, ctype = router.handle_session_delete(self.path)
             self._reply(status, payload, ctype)
 
     return Server((cfg.serve.host, cfg.serve.port), Handler)

@@ -30,6 +30,18 @@ API:
            "latency_ms": ...}
     flo:  application/octet-stream Middlebury .flo bytes
     png:  image/png flow-color rendering
+  POST /v1/flow/stream    -> body {"session": <id>, "frame": <b64 image>,
+                             "format"/"precision" as above}: the
+                             streaming video-session API
+                             (serve/session.py) — ONE frame per request.
+    202 {"primed": true, "session", "bucket", "frames"}: the frame
+        opened (or re-opened) the session; no pair yet.
+    200 the same payload as /v1/flow for the (previous, this) pair,
+        plus {"session", "frame_index"} — one decode per frame.
+    410 {"error": "session_expired"}: the session was TTL-expired or
+        LRU-evicted; resend the frame to re-prime.
+  DELETE /v1/flow/stream/<id> -> 200 {"session", "deleted": true} |
+                             404 {"error": "session_unknown"}
   Errors are structured: 4xx/5xx with a ServeError payload
   ({"error": code, "message": ...}); one bad request never affects its
   batchmates or the engine.
@@ -173,7 +185,8 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                                        "message": self.path})
 
         def do_POST(self):  # noqa: N802
-            if self.path not in ("/v1/flow", "/flow"):
+            stream = self.path in ("/v1/flow/stream", "/flow/stream")
+            if not stream and self.path not in ("/v1/flow", "/flow"):
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
                 return
@@ -190,8 +203,24 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                                      f"format must be json|flo|png, "
                                      f"got {fmt!r}")
                 precision = req.get("precision")  # None = default tier
-                prev = _decode_b64_image(req.get("prev", ""), "prev")
-                nxt = _decode_b64_image(req.get("next", ""), "next")
+                if stream:
+                    sid = req.get("session")
+                    if not isinstance(sid, str) or not sid:
+                        raise ServeError(
+                            "bad_request",
+                            "stream body needs a non-empty string "
+                            "\"session\" id")
+                    if "/" in sid:
+                        # ids ride in the DELETE URL path: a slash would
+                        # make the id unaddressable (and router/replica
+                        # would parse it differently)
+                        raise ServeError(
+                            "bad_request",
+                            f"session id {sid!r} must not contain '/'")
+                    frame = _decode_b64_image(req.get("frame", ""), "frame")
+                else:
+                    prev = _decode_b64_image(req.get("prev", ""), "prev")
+                    nxt = _decode_b64_image(req.get("next", ""), "next")
             except ServeError as e:
                 self._reply_json(400, e.payload())
                 return
@@ -199,18 +228,32 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 self._reply_json(400, {"error": "bad_request",
                                        "message": f"{type(e).__name__}: {e}"})
                 return
-            fut = engine.submit(prev, nxt, precision=precision,
-                                request_id=request_id)
+            if stream:
+                fut = engine.submit_next(sid, frame, precision=precision,
+                                         request_id=request_id)
+            else:
+                fut = engine.submit(prev, nxt, precision=precision,
+                                    request_id=request_id)
             try:
                 res = fut.result(timeout=timeout_s)
             except ServeError as e:
-                status = 400 if e.code in ("bad_input", "bad_request") else 500
+                status = (400 if e.code in ("bad_input", "bad_request")
+                          else 410 if e.code == "session_expired" else 500)
                 self._reply_json(status, e.payload())
                 return
             except _FuturesTimeout:
                 self._reply_json(504, {"error": "timeout",
                                        "message": f"no response within "
                                                   f"{timeout_s}s"})
+                return
+            if stream and res.get("primed"):
+                # 202: accepted, session primed — no pair to answer yet
+                self._reply_json(202, {
+                    "primed": True, "session": res["session"],
+                    "bucket": list(res["bucket"]),
+                    "native_hw": list(res["native_hw"]),
+                    "frames": res["frames"],
+                    "request_id": res["request_id"]})
                 return
             flow = res["flow"]
             if fmt == "flo":
@@ -227,7 +270,7 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                     return
                 self._reply(200, png.tobytes(), "image/png")
             else:
-                self._reply_json(200, {
+                payload = {
                     "shape": list(flow.shape),
                     "bucket": list(res["bucket"]),
                     "precision": res["precision"],
@@ -236,7 +279,26 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                     "request_id": res["request_id"],
                     "flow_b64": base64.b64encode(
                         np.ascontiguousarray(flow, "<f4").tobytes()).decode(),
-                })
+                }
+                if stream:
+                    payload["session"] = res["session"]
+                    payload["frame_index"] = res["frame_index"]
+                self._reply_json(200, payload)
+
+        def do_DELETE(self):  # noqa: N802
+            for prefix in ("/v1/flow/stream/", "/flow/stream/"):
+                if self.path.startswith(prefix):
+                    sid = self.path[len(prefix):]
+                    break
+            else:
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+                return
+            if engine.sessions.delete(sid):
+                self._reply_json(200, {"session": sid, "deleted": True})
+            else:
+                self._reply_json(404, {"error": "session_unknown",
+                                       "session": sid})
 
     return Server((cfg.serve.host, cfg.serve.port), Handler)
 

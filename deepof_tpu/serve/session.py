@@ -1,0 +1,263 @@
+"""Streaming video sessions: the stateful half of the serving stack.
+
+Every request to `/v1/flow` ships and decodes TWO frames, so a client
+walking a video pays 2x decode/preprocess/upload — the dominant
+real-world workload pays double host work for no reason: frame t was
+already decoded when it served as the "next" of pair (t-1, t). This
+module keeps, per session id, the last frame's decoded +
+bucket-preprocessed half-row (serve/buckets.py prepare_frame), so
+`engine.submit_next(session, frame)` forms the (prev, next) pair
+server-side from ONE new frame — one decode and one preprocess per
+frame, halving host work for video and opening temporal warm-start
+(FlowNet 2.0 lineage, PAPERS.md).
+
+Contract decisions that matter:
+
+  - Parity by construction. prepare_pair == concat(prepare_frame x 2)
+    (per-frame independent preprocess), so a streamed step's network
+    input is BITWISE the pair the client would have submitted pairwise —
+    pinned in tests/test_session.py. The cache holds preprocessed
+    float32 half-rows (~H*W*12 bytes each), never raw frames.
+  - Bounded, never silent. The store is an LRU capped at
+    `serve.session.max_sessions` with an idle TTL
+    (`serve.session.ttl_s`) enforced by a sweeper thread AND exactly on
+    access. Every eviction leaves a tombstone: the session's next use is
+    a structured `session_expired` error the client re-primes from — a
+    session can end, but it cannot vanish silently.
+  - Sessions are engine-local state; requests stay pure at the fleet
+    level. The router (serve/router.py) pins a session to one replica
+    (sticky map) so its cached frame is where its frames land; replica
+    loss demotes to a structured `session_lost` reply — there is no
+    cross-replica state migration, the client re-primes.
+  - A frame ADVANCES the session at submit time, before its flow
+    resolves: frame t+1 pairs with frame t whether or not pair (t-1, t)
+    dispatched cleanly, exactly like the pairwise walk would.
+  - A mid-session resolution change (a new frame mapping to a different
+    bucket) re-primes in place: the cached half-row is at the old bucket
+    resolution, so the pair cannot be formed — the caller gets a fresh
+    `primed` reply (counted, visible) instead of a resize surprise.
+
+Observability: the engine surfaces the `serve_sessions_*` counter block
+(active/created/expired/evicted/resumed/deleted/rebucketed, frames,
+steps, decode savings) and a per-session-frame latency histogram
+(`serve_session_latency_hist`, obs/export.py fixed buckets — merges
+exactly at the router) through stats()/heartbeat/metrics/analyze/tail;
+`session_prime`/`session_step` trace spans carry the session id next to
+X-Request-Id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+#: Tombstones retained after eviction/expiry so the NEXT use of a dead
+#: session id is a structured `session_expired`, not an accidental fresh
+#: prime. Bounded independently of max_sessions (tombstones are a few
+#: bytes each; the bound only exists so the map cannot grow forever).
+TOMBSTONE_CAP = 4096
+
+
+class _Session:
+    __slots__ = ("sid", "row", "bucket", "native_hw", "tier", "frames",
+                 "last_m")
+
+    def __init__(self, sid, row, bucket, native_hw, tier, now):
+        self.sid = sid
+        self.row = row              # prepare_frame half-row (H, W, 3) f32
+        self.bucket = bucket
+        self.native_hw = native_hw
+        self.tier = tier            # default precision for this session's steps
+        self.frames = 1
+        self.last_m = now
+
+
+class SessionExpired(KeyError):
+    """The session id names a session that was evicted (LRU pressure) or
+    expired (idle TTL) — the structured `session_expired` trigger. The
+    tombstone survives this raise, so the client's re-prime of the same
+    id is counted as `resumed`."""
+
+    def __init__(self, sid: str, reason: str):
+        super().__init__(sid)
+        self.sid = sid
+        self.reason = reason  # "expired" (TTL) | "evicted" (LRU)
+
+
+class SessionStore:
+    """Bounded, thread-safe session cache (see module docstring).
+
+    max_sessions / ttl_s / sweep_s: ServeConfig.session knobs (the
+    engine passes cfg.serve.session through). A sweeper thread runs only
+    when both ttl_s and sweep_s are > 0; TTL is additionally enforced
+    exactly on access, so correctness never depends on sweep cadence.
+    """
+
+    def __init__(self, max_sessions: int = 256, ttl_s: float = 120.0,
+                 sweep_s: float = 5.0):
+        self.max_sessions = max(int(max_sessions), 1)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, _Session] = OrderedDict()
+        self._tombstones: OrderedDict[str, str] = OrderedDict()
+        # --- counters (read via stats(); guarded by _lock) ---
+        self._created = 0
+        self._resumed = 0     # re-primes of a tombstoned (dead) id
+        self._expired = 0     # TTL
+        self._evicted = 0     # LRU pressure
+        self._deleted = 0     # explicit DELETE
+        self._rebucketed = 0  # mid-session resolution change re-primes
+        self._frames = 0      # every accepted frame (primes + steps)
+        self._steps = 0       # frames that formed a pair from the cache
+        self._stop = threading.Event()
+        self._sweeper = None
+        if self.ttl_s > 0 and float(sweep_s) > 0:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, args=(float(sweep_s),),
+                daemon=True, name="serve-session-sweeper")
+            self._sweeper.start()
+
+    # ------------------------------------------------------------- core
+    def _expire_locked(self, sid: str, reason: str) -> None:
+        self._sessions.pop(sid, None)
+        self._tombstones[sid] = reason
+        self._tombstones.move_to_end(sid)
+        while len(self._tombstones) > TOMBSTONE_CAP:
+            self._tombstones.popitem(last=False)
+        if reason == "expired":
+            self._expired += 1
+        else:
+            self._evicted += 1
+
+    def _fresh_locked(self, s: _Session, now: float) -> bool:
+        return self.ttl_s <= 0 or now - s.last_m <= self.ttl_s
+
+    def contains(self, sid: str) -> bool:
+        """Live-and-fresh probe (no LRU touch) — the span-naming hint;
+        advance() is the authority."""
+        now = time.monotonic()
+        with self._lock:
+            s = self._sessions.get(sid)
+            return s is not None and self._fresh_locked(s, now)
+
+    def advance(self, sid: str, row: np.ndarray, bucket: tuple[int, int],
+                native_hw: tuple[int, int], tier: str):
+        """Accept one frame for `sid`, atomically.
+
+        Returns ("primed", session) when the frame opens (or re-opens)
+        the session — no pair to dispatch — or ("step", prev_row,
+        session) with the PREVIOUS frame's half-row: the caller forms
+        the (prev, next) network input by channel concat. The stored
+        frame advances to `row` either way. Raises SessionExpired when
+        `sid` is tombstoned (evicted/TTL-expired): the structured
+        `session_expired` path — the client re-primes, and that re-prime
+        clears the tombstone and counts as `resumed`.
+        """
+        now = time.monotonic()
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None and not self._fresh_locked(s, now):
+                # lazy TTL: exact even if the sweeper has not run yet;
+                # the rejected frame is NOT counted — the client's
+                # re-prime of this id is, as `resumed`
+                self._expire_locked(sid, "expired")
+                self._tombstones[sid] = "notified"  # this raise notifies
+                raise SessionExpired(sid, "expired")
+            if s is None:
+                reason = self._tombstones.get(sid)
+                if reason is not None and reason != "notified":
+                    # first use of a dead id: the structured error the
+                    # client re-primes from (the RETRY is the resume)
+                    self._tombstones[sid] = "notified"
+                    self._tombstones.move_to_end(sid)
+                    raise SessionExpired(sid, reason)
+            self._frames += 1
+            if s is None:
+                if self._tombstones.pop(sid, None) is not None:
+                    self._resumed += 1
+                else:
+                    self._created += 1
+                s = _Session(sid, row, bucket, native_hw, tier, now)
+                self._sessions[sid] = s
+                self._sessions.move_to_end(sid)
+                while len(self._sessions) > self.max_sessions:
+                    old_sid, _ = next(iter(self._sessions.items()))
+                    self._expire_locked(old_sid, "evicted")
+                return ("primed", s)
+            if s.bucket != tuple(bucket):
+                # resolution changed mid-session: the cached half-row is
+                # at the old bucket shape — re-prime in place, loudly
+                self._rebucketed += 1
+                s.row, s.bucket = row, tuple(bucket)
+                s.native_hw, s.tier = tuple(native_hw), tier
+                s.frames += 1
+                s.last_m = now
+                self._sessions.move_to_end(sid)
+                return ("primed", s)
+            prev = s.row
+            s.row = row
+            s.native_hw = tuple(native_hw)
+            s.tier = tier
+            s.frames += 1
+            s.last_m = now
+            self._steps += 1
+            self._sessions.move_to_end(sid)
+            return ("step", prev, s)
+
+    def delete(self, sid: str) -> bool:
+        """Explicit session end (DELETE /v1/flow/stream/<id>). No
+        tombstone: the id's next frame is a fresh prime, not an error.
+        False when the id names nothing live."""
+        with self._lock:
+            self._tombstones.pop(sid, None)  # a deleted id starts clean
+            s = self._sessions.pop(sid, None)
+            if s is None:
+                return False
+            self._deleted += 1
+            return True
+
+    # ---------------------------------------------------------- sweeper
+    def sweep(self) -> int:
+        """Expire every session idle past ttl_s; returns how many. The
+        sweeper thread calls this every sweep_s; tests call it directly."""
+        if self.ttl_s <= 0:
+            return 0
+        now = time.monotonic()
+        with self._lock:
+            dead = [sid for sid, s in self._sessions.items()
+                    if not self._fresh_locked(s, now)]
+            for sid in dead:
+                self._expire_locked(sid, "expired")
+        return len(dead)
+
+    def _sweep_loop(self, sweep_s: float) -> None:
+        while not self._stop.wait(sweep_s):
+            self.sweep()
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        """The serve_sessions_* counter block (the engine merges it into
+        its stats()). decode_saved == steps: every step decoded and
+        preprocessed ONE frame where the pairwise walk would have paid
+        two for the same (prev, next) output."""
+        with self._lock:
+            return {
+                "serve_sessions_active": len(self._sessions),
+                "serve_sessions_created": self._created,
+                "serve_sessions_resumed": self._resumed,
+                "serve_sessions_expired": self._expired,
+                "serve_sessions_evicted": self._evicted,
+                "serve_sessions_deleted": self._deleted,
+                "serve_sessions_rebucketed": self._rebucketed,
+                "serve_sessions_frames": self._frames,
+                "serve_sessions_steps": self._steps,
+                "serve_sessions_decode_saved": self._steps,
+            }
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=5.0)

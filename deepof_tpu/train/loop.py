@@ -865,6 +865,18 @@ class Trainer:
                     consecutive_nans = 0
 
                 if eval_due:
+                    if heartbeat is not None:
+                        # flush BEFORE the sweep: the first eval's XLA
+                        # trace/lowering is GIL-bound Python — on a
+                        # contended host it starves the heartbeat writer
+                        # thread for its whole duration, and the elastic
+                        # coordinator would judge the (fresh-but-frozen)
+                        # file stale and evict a healthy host mid-eval.
+                        # A synchronous write re-bases the supervisor's
+                        # staleness clock to the eval's start (CHANGES
+                        # PR 9 known-benign, fixed here; pinned in
+                        # tests/test_elastic.py host_verdict timing)
+                        heartbeat.touch(flush=True)
                     with obs_trace.span("eval", step=gstep):
                         last_eval = self.evaluate(dump=cfg.train.dump_visuals)
                     self.logger.log("eval", gstep, epoch=epoch, **last_eval)

@@ -107,6 +107,62 @@ def test_host_verdict_gating():
                         0.0) == "ok"
 
 
+def test_host_verdict_eval_compile_window_with_pre_eval_flush(tmp_path):
+    """The PR 9 known-benign false-stale, pinned on host_verdict's
+    timing inputs: a GIL-bound eval compile starves the heartbeat
+    WRITER thread, so the file's `time` freezes for the compile's whole
+    duration. Without the pre-eval flush the frozen timestamp can
+    already be up to a heartbeat period old (plus accrued step age) —
+    the verdict goes "stale" mid-compile on a healthy host. With the
+    loop's touch(flush=True) at eval entry (train/loop.py), the frozen
+    file is stamped AT the compile's start, so the coordinator's full
+    stale_after_s window measures the compile itself."""
+    import json
+    import os
+
+    from deepof_tpu.obs.heartbeat import Heartbeat
+
+    stale_after, wedge_after = 15.0, 45.0
+    t_eval = 1000.0  # wall time the eval compile begins
+
+    # WITHOUT the flush: last write landed a period before the compile
+    # and the age clock carried the pre-eval accrual — 15 s into a 20 s
+    # compile the file looks dead even though the host is healthy.
+    unflushed = {"pid": 7, "time": t_eval - 5.0, "wedged": False,
+                 "beats": 3, "last_step_age_s": 12.0}
+    assert host_verdict(unflushed, 7, t_eval + 10.1, stale_after,
+                        wedge_after) == "stale"
+
+    # WITH the flush: the file is stamped at t_eval with age reset, so
+    # the same 10 s of frozen writer reads healthy...
+    flushed = {"pid": 7, "time": t_eval, "wedged": False, "beats": 3,
+               "last_step_age_s": 0.0}
+    assert host_verdict(flushed, 7, t_eval + 10.1, stale_after,
+                        wedge_after) == "ok"
+    # ... for the entire stale_after_s window measured from eval entry
+    assert host_verdict(flushed, 7, t_eval + stale_after - 0.1,
+                        stale_after, wedge_after) == "ok"
+    # a compile genuinely longer than the window is still caught — the
+    # fix re-bases the clock, it does not blind the supervisor
+    assert host_verdict(flushed, 7, t_eval + stale_after + 0.1,
+                        stale_after, wedge_after) == "stale"
+
+    # and the Heartbeat side of the contract: touch(flush=True) rewrites
+    # the file synchronously from the CALLING thread — no dependence on
+    # the background writer that the compile is about to starve
+    path = tmp_path / "heartbeat.json"
+    hb = Heartbeat(str(path), period_s=3600.0, devmem=False)
+    try:
+        assert not os.path.exists(path)  # writer parked for an hour
+        hb.beat(4)
+        hb.touch(flush=True)
+        rec = json.loads(path.read_text())
+        assert rec["step"] == 4 and rec["beats"] == 1
+        assert rec["last_step_age_s"] < 1.0  # age re-based at the flush
+    finally:
+        hb.close()
+
+
 # ------------------------------------------------- host chaos hook
 
 

@@ -26,6 +26,17 @@ subprocesses behind the health-gated router, driven closed-loop by
 a 1-replica fleet — `speedup_vs_single` is the fleet scale-out win
 through the full HTTP + routing + supervision path.
 
+--stream instead benchmarks the streaming video-session API
+(serve/session.py): a closed-loop client walks the SAME frame sequence
+twice — once as a session (`engine.submit_next`, one decode per frame)
+and once as the equivalent pairwise `/v1/flow` walk (two decodes per
+pair) — against an engine whose decode is instrumented with an injected
+per-decode delay (`--decode-ms`), the honest stand-in for real
+jpeg/png decode + preprocess cost on a decode-bound workload. Reports
+`stream_speedup` (the ISSUE 10 acceptance: >= 1.5x on a decode-bound
+walk), the measured decode-count delta, and `flow_bitwise_equal` — the
+streamed flows must be bit-identical to the pairwise walk's.
+
 --precision [f32,bf16,int8] sweeps the mixed-precision serving tiers
 (serve/quant.py) through ONE real-model engine: per tier it reports
 requests/s, p50/p99 latency, the weight bytes each dispatch moves, and
@@ -73,6 +84,15 @@ FLEET_REQUIRED_KEYS = (
     "mode", "replicas", "clients", "requests", "errors", "wall_s",
     "requests_per_s", "single_wall_s", "single_requests_per_s",
     "speedup_vs_single", "failovers", "shed", "max_batch", "exec_ms",
+)
+
+#: keys every --stream result carries (schema smoke test)
+STREAM_REQUIRED_KEYS = (
+    "mode", "frames", "flows", "errors", "wall_s", "frames_per_s",
+    "pairwise_wall_s", "pairwise_frames_per_s", "stream_speedup",
+    "stream_decodes", "pairwise_decodes", "decode_delta", "decode_saved",
+    "flow_bitwise_equal", "latency_p50_ms", "latency_p99_ms",
+    "max_batch", "timeout_ms", "decode_ms", "exec_ms", "bucket",
 )
 
 #: keys every --precision result carries at the top level ...
@@ -184,6 +204,117 @@ def serve_bench(requests: int = 64, gap_ms: float = 1.0, max_batch: int = 8,
         out["serial_requests_per_s"] = round((len(pairs) - serr) / swall, 2)
         out["speedup_vs_serial"] = round(swall / wall, 2) if wall > 0 else None
     return out
+
+
+# ------------------------------------------------------------ stream
+
+
+def _instrument_decode(engine, decode_ms: float, counter: dict) -> None:
+    """Wrap the engine's decode with a per-decode delay + call counter:
+    the injected stand-in for real image decode + preprocess cost (the
+    synthetic arrays the bench feeds decode in microseconds, which would
+    hide exactly the work the session cache exists to halve)."""
+    orig = engine._decode
+
+    def decode(img):
+        counter["n"] += 1
+        if decode_ms > 0:
+            time.sleep(decode_ms / 1e3)
+        return orig(img)
+
+    engine._decode = decode
+
+
+def stream_bench(frames: int = 32, decode_ms: float = 20.0,
+                 exec_ms: float = 2.0, max_batch: int = 4,
+                 timeout_ms: float = 2.0, bucket: tuple[int, int] = (32, 64),
+                 native_hw: tuple[int, int] = (30, 60),
+                 log_dir: str | None = None) -> dict:
+    """Closed-loop video walk, streamed vs pairwise (see module
+    docstring). Both walks drive the identical frame sequence through
+    identically configured engines with the same injected decode delay;
+    the only variable is the session cache — so `stream_speedup` is the
+    one-decode-per-frame win and nothing else."""
+    from deepof_tpu.serve.engine import ServeError  # noqa: F401 (doc)
+
+    cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    rng = np.random.RandomState(0)
+    frames = max(int(frames), 2)
+    imgs = [rng.randint(1, 255, (*native_hw, 3), dtype=np.uint8)
+            for _ in range(frames)]
+
+    def walk_pairwise():
+        counter = {"n": 0}
+        flows, errors = [], 0
+        with InferenceEngine(cfg, forward_fn=make_fake_forward(
+                exec_ms)) as engine:
+            engine.warm()
+            _instrument_decode(engine, decode_ms, counter)
+            t0 = time.perf_counter()
+            for prev, nxt in zip(imgs, imgs[1:]):
+                try:
+                    flows.append(engine.submit(prev, nxt).result(
+                        timeout=120.0)["flow"])
+                except Exception:  # noqa: BLE001 - counted
+                    errors += 1
+                    flows.append(None)
+            wall = time.perf_counter() - t0
+        return wall, errors, flows, counter["n"], None
+
+    def walk_stream():
+        counter = {"n": 0}
+        flows, errors = [], 0
+        with InferenceEngine(cfg, forward_fn=make_fake_forward(
+                exec_ms)) as engine:
+            engine.warm()
+            _instrument_decode(engine, decode_ms, counter)
+            t0 = time.perf_counter()
+            primed = engine.submit_next("bench", imgs[0]).result(
+                timeout=120.0)
+            assert primed.get("primed"), primed
+            for frame in imgs[1:]:
+                try:
+                    flows.append(engine.submit_next("bench", frame).result(
+                        timeout=120.0)["flow"])
+                except Exception:  # noqa: BLE001 - counted
+                    errors += 1
+                    flows.append(None)
+            wall = time.perf_counter() - t0
+            stats = engine.stats()
+        return wall, errors, flows, counter["n"], stats
+
+    pw_wall, pw_err, pw_flows, pw_decodes, _ = walk_pairwise()
+    st_wall, st_err, st_flows, st_decodes, st_stats = walk_stream()
+
+    n_flows = frames - 1
+    equal = bool(pw_flows and len(pw_flows) == len(st_flows) and all(
+        a is not None and b is not None and np.array_equal(a, b)
+        for a, b in zip(pw_flows, st_flows)))
+    st_rate = ((n_flows - st_err) / st_wall) if st_wall > 0 else None
+    pw_rate = ((n_flows - pw_err) / pw_wall) if pw_wall > 0 else None
+    return {
+        "mode": "stream", "frames": frames, "flows": n_flows,
+        "errors": st_err, "wall_s": round(st_wall, 4),
+        "frames_per_s": round(st_rate, 2) if st_rate else None,
+        "pairwise_errors": pw_err,
+        "pairwise_wall_s": round(pw_wall, 4),
+        "pairwise_frames_per_s": round(pw_rate, 2) if pw_rate else None,
+        "stream_speedup": (round(st_rate / pw_rate, 2)
+                           if st_rate and pw_rate else None),
+        # measured decode ledger: N for the stream, 2(N-1) pairwise —
+        # the one-decode-per-frame contract as raw counts
+        "stream_decodes": st_decodes,
+        "pairwise_decodes": pw_decodes,
+        "decode_delta": pw_decodes - st_decodes,
+        "decode_saved": st_stats["serve_sessions_decode_saved"],
+        "flow_bitwise_equal": equal,
+        "latency_p50_ms": st_stats["serve_session_latency_p50_ms"],
+        "latency_p99_ms": st_stats["serve_session_latency_p99_ms"],
+        "session_frames": st_stats["serve_sessions_frames"],
+        "max_batch": max_batch, "timeout_ms": timeout_ms,
+        "decode_ms": decode_ms, "exec_ms": exec_ms,
+        "bucket": list(bucket),
+    }
 
 
 # --------------------------------------------------------- precision
@@ -439,9 +570,14 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--gap-ms", type=float, default=1.0)
     ap.add_argument("--max-batch", type=int, default=8)
-    ap.add_argument("--timeout-ms", type=float, default=10.0)
-    ap.add_argument("--exec-ms", type=float, default=10.0,
-                    help="fake mode: per-dispatch executor latency")
+    ap.add_argument("--timeout-ms", type=float, default=None,
+                    help="batcher flush timeout (default 10; 2 in "
+                         "--stream mode, where a closed-loop walk never "
+                         "coalesces and the timeout is pure overhead)")
+    ap.add_argument("--exec-ms", type=float, default=None,
+                    help="fake mode: per-dispatch executor latency "
+                         "(default 10; 2 in --stream mode so the walk "
+                         "stays decode-bound)")
     ap.add_argument("--bucket", default="64x64", metavar="HxW")
     ap.add_argument("--native", default="48x96", metavar="HxW",
                     help="native resolution of the synthetic requests")
@@ -458,6 +594,18 @@ def main(argv=None) -> int:
                          "clients) against a 1-replica fleet")
     ap.add_argument("--clients", type=int, default=8,
                     help="fleet mode: concurrent closed-loop HTTP clients")
+    ap.add_argument("--stream", action="store_true",
+                    help="benchmark the streaming video-session API: a "
+                         "closed-loop session walk vs the equivalent "
+                         "pairwise /v1/flow walk over the same frames "
+                         "(injected --decode-ms per decode), reporting "
+                         "stream_speedup, the decode-count delta, and "
+                         "bitwise flow parity")
+    ap.add_argument("--frames", type=int, default=32,
+                    help="stream mode: frames in the walked video")
+    ap.add_argument("--decode-ms", type=float, default=20.0,
+                    help="stream mode: injected per-decode delay (the "
+                         "decode-bound workload stand-in)")
     ap.add_argument("--precision", nargs="?", const="f32,bf16,int8",
                     default=None, metavar="TIERS",
                     help="sweep mixed-precision serving tiers (comma "
@@ -470,7 +618,21 @@ def main(argv=None) -> int:
         h, w = spec.lower().split("x")
         return (int(h), int(w))
 
-    if args.precision is not None:
+    # per-mode defaults: a closed-loop stream walk never coalesces, so
+    # the batch timeout and executor sleep are pure per-flow overhead
+    # there — the other modes keep the historical 10 ms figures
+    fast = 2.0 if args.stream else 10.0
+    exec_ms = args.exec_ms if args.exec_ms is not None else fast
+    timeout_ms = args.timeout_ms if args.timeout_ms is not None else fast
+    args.exec_ms, args.timeout_ms = exec_ms, timeout_ms
+
+    if args.stream:
+        res = stream_bench(frames=args.frames, decode_ms=args.decode_ms,
+                           exec_ms=exec_ms, max_batch=args.max_batch,
+                           timeout_ms=timeout_ms,
+                           bucket=hw(args.bucket), native_hw=hw(args.native),
+                           log_dir=args.log_dir)
+    elif args.precision is not None:
         res = precision_bench(
             requests=args.requests, gap_ms=args.gap_ms,
             max_batch=args.max_batch, timeout_ms=args.timeout_ms,
