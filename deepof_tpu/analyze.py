@@ -298,7 +298,8 @@ def aggregate_processes(log_dir: str, now: float | None = None) -> dict | None:
         serve = child.get("serve") or {}
         for k in ("requests", "responses", "errors", "batches",
                   "sessions_active", "sessions_created", "sessions_frames",
-                  "sessions_steps", "sessions_decode_saved"):
+                  "sessions_steps", "sessions_decode_saved",
+                  "sessions_warm_steps", "sessions_cold_fallbacks"):
             if isinstance(serve.get(k), (int, float)):
                 merged[k] = merged.get(k, 0) + serve[k]
         for k, v in serve.items():

@@ -386,6 +386,24 @@ class SessionConfig:
     # sweeper-thread cadence; <= 0 disables the background sweep (TTL is
     # then enforced only lazily on access)
     sweep_s: float = 5.0
+    # Temporal warm-start (DESIGN.md "Temporal warm-start"): keep frame
+    # t's predicted flow at bucket resolution in the session and dispatch
+    # step (t, t+1) through a refinement-only executable (FlowNetCS-style
+    # S stage on [img1, img2, warp(img2, prior), prior, brightness_err])
+    # instead of the full cold network. Adds a third executable axis —
+    # (bucket, tier, cold|warm) — to the engine and `warmup --serve`.
+    # Default OFF until the serve_bench --stream `epe_vs_cold` quality
+    # gate passes for the deployed weights; a session's first step (and
+    # any step after a re-prime/rebucket, which DROP the cached flow)
+    # falls back to the cold path.
+    warm_start: bool = False
+    # Width multiplier of the standalone warm refinement stage relative
+    # to the serving model's width (models without a trained refinement
+    # stage get a deterministic seeded FlowNetRefine at width_mult *
+    # warm_width; flownet_cs reuses its checkpoint's full-width refine
+    # stage and ignores this). < 1 is what makes the warm path cheaper
+    # than the cold network.
+    warm_width: float = 0.5
 
 
 @dataclass(frozen=True)

@@ -7,6 +7,15 @@ loss kernel), and a FlowNet-S refinement stage consumes
 [img1, img2, warped img2, flow, brightness error] (12 channels) to
 predict the residual-corrected pyramid.
 
+The refinement stage is also exposed STANDALONE as `FlowNetRefine`: a
+module that accepts an externally supplied prior flow instead of running
+the base network — the serving warm-start path (serve/engine.py,
+DESIGN.md "Temporal warm-start") feeds it the previous video frame's
+flow so a streamed step skips the full cold network. `FlowNetRefine`
+applies the SAME stage (same `refine`-scoped FlowNetS, same stacked
+input built by `refinement_inputs`), so a trained FlowNetCS checkpoint's
+`refine` subtree drops in as its params unchanged.
+
 Adaptation notes (documented divergences from the paper):
   - trained end-to-end with the unsupervised pyramid loss on the
     refinement stage's outputs — gradients reach the base network through
@@ -14,7 +23,15 @@ Adaptation notes (documented divergences from the paper):
     supervised EPE; there is no ground truth in this framework's
     training regime);
   - 2-frame only (the multi-frame volume path pairs naturally with the
-    single-stage models).
+    single-stage models);
+  - `FlowNetRefine(residual=True)` (the standalone/warm-serving variant
+    for models WITHOUT a trained refinement stage) follows FlowNet 2.0's
+    warped-input increment formulation: the stage's pyramid is a gated
+    correction ADDED to the prior, with the gate zero-initialized so an
+    untrained stage is exactly the identity on its prior — the serving
+    quality gate (`epe_vs_cold`) then measures temporal drift, never
+    random-init noise, and training can grow the correction from a safe
+    starting point.
 """
 
 from __future__ import annotations
@@ -28,6 +45,23 @@ from flax import linen as nn
 from ..ops.warp import backward_warp
 from .flownet_c import FlowNetC
 from .flownet_s import FLOW_SCALES, FlowNetS
+
+
+def refinement_inputs(img1: jnp.ndarray, img2: jnp.ndarray,
+                      flow: jnp.ndarray, dtype: Any) -> jnp.ndarray:
+    """The FlowNet 2.0 stacked refinement input: [img1, img2,
+    warp(img2, flow), flow, brightness error] — 12 channels at input
+    resolution. `flow` must be at input resolution in input pixel units
+    (already scale-applied). ONE definition shared by FlowNetCS (prior
+    from its own base stage) and FlowNetRefine (prior supplied by the
+    caller — the serving warm path), so the two stages see bitwise the
+    same stacked input for the same (pair, prior)."""
+    warped = backward_warp(img2.astype(jnp.float32), flow)
+    err = jnp.sqrt(jnp.sum(jnp.square(img1.astype(jnp.float32) - warped),
+                           axis=-1, keepdims=True) + 1e-12)
+    return jnp.concatenate(
+        [img1, img2, warped.astype(dtype), flow.astype(dtype),
+         err.astype(dtype)], axis=-1)
 
 
 class FlowNetCS(nn.Module):
@@ -58,12 +92,91 @@ class FlowNetCS(nn.Module):
         flow = base[0].astype(jnp.float32) * self.flow_scales[0]
         flow = jax.image.resize(flow, (b, h, w, 2), "bilinear") * 2.0
 
-        warped = backward_warp(img2.astype(jnp.float32), flow)
-        err = jnp.sqrt(jnp.sum(jnp.square(img1.astype(jnp.float32) - warped),
-                               axis=-1, keepdims=True) + 1e-12)
-        refine_in = jnp.concatenate(
-            [img1, img2, warped.astype(self.dtype), flow.astype(self.dtype),
-             err.astype(self.dtype)], axis=-1)
+        refine_in = refinement_inputs(img1, img2, flow, self.dtype)
         return FlowNetS(flow_channels=2, dtype=self.dtype,
                         flow_scales=self.flow_scales,
                         name="refine")(refine_in)
+
+
+class FlowNetRefine(nn.Module):
+    """The FlowNetCS refinement stage, standalone: (pair, prior flow) ->
+    refined pyramid, no base network.
+
+    `pair` is the engine's 6-channel preprocessed input; `prior` is a
+    finest-head-resolution scaled flow — a previous dispatch's raw
+    output, stored verbatim by the serving session (serve/session.py);
+    see __call__. Params scope the inner FlowNetS as `refine`, so:
+
+      residual=False — the stage predicts the corrected flow directly
+          (FlowNetCS semantics); a trained `flownet_cs` checkpoint's
+          `refine` subtree is exactly this module's params (the engine
+          reuses it for warm serving of flownet_cs).
+      residual=True  — the stage predicts a GATED correction added to
+          the prior at every pyramid level (FlowNet 2.0's warped-input
+          increment), with the scalar gate zero-initialized: an
+          untrained stage reproduces its prior exactly. This is the
+          variant the engine builds (deterministic seeded init, width
+          scaled by serve.session.warm_width) for models without a
+          trained refinement stage.
+    """
+
+    flow_channels: int = 2
+    dtype: Any = jnp.float32
+    width_mult: float = 1.0
+    residual: bool = False
+
+    flow_scales: tuple[float, ...] = FLOW_SCALES
+    max_downsample = 64
+
+    @nn.compact
+    def __call__(self, pair: jnp.ndarray,
+                 prior: jnp.ndarray) -> list[jnp.ndarray]:
+        """`prior` is a FINEST-HEAD-resolution scaled flow — exactly a
+        previous dispatch's `flows[0] * flow_scales[0]` (the serving
+        session stores it verbatim, serve/session.py), the same
+        half-resolution scale space FlowNetCS's base estimate lives in.
+        The stage upsamples it to input resolution for the warp (the
+        FlowNetCS x2 convention); keeping the prior on the head grid
+        makes the residual identity EXACT at the finest level — no
+        down/up resample loss can accumulate along a video walk."""
+        if pair.shape[-1] != 6 or self.flow_channels != 2:
+            raise ValueError(
+                "FlowNetRefine is a 2-frame stage (6 input channels, 2 "
+                f"flow channels); got input {pair.shape[-1]}ch / "
+                f"{self.flow_channels} flow channels")
+        if prior.shape[-1] != 2 or prior.shape[0] != pair.shape[0]:
+            raise ValueError(
+                f"prior flow must be (B, h, w, 2); got {prior.shape} "
+                f"for pair {pair.shape}")
+        b, h, w, _ = pair.shape
+        ph, pw = prior.shape[1:3]
+        img1, img2 = pair[..., :3], pair[..., 3:]
+        prior = prior.astype(jnp.float32)
+        # finest head lives at half resolution; x2 the vectors when
+        # upsampling to input resolution (identical to FlowNetCS's
+        # handling of its base estimate)
+        flow_full = jax.image.resize(prior, (b, h, w, 2),
+                                     "bilinear") * 2.0
+        refine_in = refinement_inputs(img1, img2, flow_full, self.dtype)
+        flows = FlowNetS(flow_channels=2, dtype=self.dtype,
+                         width_mult=self.width_mult,
+                         flow_scales=self.flow_scales,
+                         name="refine")(refine_in)
+        if not self.residual:
+            return flows
+        gate = self.param("gate", nn.initializers.zeros, (), jnp.float32)
+        out = []
+        for k, f in enumerate(flows):
+            hk, wk = f.shape[1:3]
+            if (hk, wk) == (ph, pw):
+                # the finest level shares the prior's grid: no resample,
+                # so gate=0 reproduces the prior exactly
+                p = prior / self.flow_scales[k]
+            else:
+                # coarser levels: resize to the level's grid, rescale
+                # vectors to level pixels, divide out the level's scale
+                p = jax.image.resize(prior, (b, hk, wk, 2), "bilinear")
+                p = p * (jnp.asarray([wk / pw, hk / ph], jnp.float32)
+                         / self.flow_scales[k])
+            out.append(gate * f.astype(jnp.float32) + p)
+        return out

@@ -45,6 +45,21 @@ Design decisions that matter:
     concat of two per-frame preprocesses). The store is LRU + TTL
     bounded; a dead session's next frame is a structured
     `session_expired` the client re-primes from.
+  - Temporal warm-start (`serve.session.warm_start`, DESIGN.md
+    "Temporal warm-start"): the session additionally keeps the last
+    step's RESOLVED flow (raw finest-head output), and a step that has one
+    dispatches a refinement-only executable — FlowNetRefine
+    (models/flownet2.py) on [img1, img2, warp(img2, prior), prior,
+    brightness_err] — instead of the full cold network. The executable
+    lattice gains a third axis: `_compiled` is keyed (bucket, tier,
+    cold|warm), the batcher groups warm steps exactly like a tier
+    switch, and `warmup --serve` pre-lowers the whole bucket x tier x
+    mode lattice. A step with no prior (first step, or any step after a
+    re-prime/rebucket dropped it) falls back cold — counted as
+    `serve_sessions_cold_fallbacks` next to `serve_sessions_warm_steps`.
+    Custom/fake executors are warm-blind (one forward fn, no refinement
+    weights): warm steps still group/count/trace as warm, but execute
+    the same function — grouping and bookkeeping testable without jax.
 
 Observability: trace spans (serve_enqueue / serve_batch /
 serve_dispatch / serve_postprocess, session_prime / session_step) on
@@ -107,10 +122,12 @@ class ServeError(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "bucket", "tier", "native_hw", "future", "t_enq",
-                 "rid", "session", "frame_index")
+                 "rid", "session", "frame_index", "mode", "prior",
+                 "session_epoch")
 
     def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid,
-                 session=None, frame_index=None):
+                 session=None, frame_index=None, mode="cold", prior=None,
+                 session_epoch=None):
         self.x = x
         self.bucket = bucket
         self.tier = tier
@@ -123,12 +140,20 @@ class _Request:
         # observed into the per-session-frame latency histogram
         self.session = session
         self.frame_index = frame_index
+        # temporal warm-start: mode "warm" dispatches the refinement
+        # executable with `prior` = the session's cached flow (a prior
+        # dispatch's raw finest-head output; always None for mode "cold")
+        self.mode = mode
+        self.prior = prior
+        # the session's prime-generation at advance() time: the
+        # writeback token set_flow guards on (None off-session)
+        self.session_epoch = session_epoch
 
     @property
-    def key(self) -> tuple[tuple[int, int], str]:
+    def key(self) -> tuple[tuple[int, int], str, str]:
         """The dispatch-group identity: requests batch together iff they
-        share (bucket, tier) — one executable per key."""
-        return (self.bucket, self.tier)
+        share (bucket, tier, mode) — one executable per key."""
+        return (self.bucket, self.tier, self.mode)
 
 
 def build_serve_model(cfg: ExperimentConfig):
@@ -158,6 +183,87 @@ def make_raw_forward(model) -> Callable:
         return flows[0] * model.flow_scales[0]
 
     return fwd
+
+
+def build_refine_model(cfg: ExperimentConfig):
+    """The warm-path refinement stage for a config (models/flownet2.py
+    FlowNetRefine) — ONE definition shared by the engine and
+    `warmup --serve` so their lowerings share a cache key.
+
+    flownet_cs configs reuse their own full-width refinement stage
+    (direct-prediction semantics; the checkpoint's `refine` subtree IS
+    this module's params). Every other 2-frame model gets a standalone
+    gated-residual stage at `width_mult * serve.session.warm_width` with
+    a deterministic seeded init (`refine_init_params`) — identity on its
+    prior until a trained refinement checkpoint exists."""
+    from ..models.flownet2 import FlowNetRefine
+
+    if cfg.model == "flownet_cs":
+        return FlowNetRefine(width_mult=1.0, residual=False)
+    return FlowNetRefine(
+        width_mult=cfg.width_mult * float(cfg.serve.session.warm_width),
+        residual=True)
+
+
+def refine_init_params(cfg: ExperimentConfig, refine_model):
+    """Deterministic (cfg.train.seed) init of the standalone refinement
+    stage. Conv params are spatial-shape-independent, so one init at any
+    /64-friendly size serves every bucket; the fixed seed is what makes
+    the warm path bit-stable across engines and replicas."""
+    import jax
+    import jax.numpy as jnp
+
+    variables = refine_model.init(
+        jax.random.PRNGKey(cfg.train.seed),
+        jnp.zeros((1, 64, 64, PAIR_CHANNELS), jnp.float32),
+        jnp.zeros((1, 32, 32, 2), jnp.float32))
+    return variables["params"]
+
+
+def make_refine_forward(refine_model) -> Callable:
+    """(refine_params, pairs[B,H,W,6], prior[B,H,W,2]) -> finest scaled
+    flow [B,h,w,2] — the warm twin of make_raw_forward, defined once so
+    the engine's runtime lowering and warmup's AOT lowering share a
+    persistent-cache key. Same dequantize-inside-the-trace contract as
+    the cold forward (int8 refine tiers stay int8 at the boundary)."""
+
+    def fwd(params, x, prior):
+        flows = refine_model.apply({"params": dequantize_params(params)},
+                                   x, prior)
+        return flows[0] * refine_model.flow_scales[0]
+
+    return fwd
+
+
+def cold_output_hw(cold_fwd, cold_params, bucket: tuple[int, int],
+                   max_batch: int) -> tuple[int, int]:
+    """The (h, w) grid of the COLD executable's output for one bucket —
+    derived abstractly (eval_shape; nothing runs). This is the grid the
+    session's warm-start prior lives on: the prior is a previous
+    dispatch's output stored verbatim, so the warm executable's prior
+    aval must match the cold executable's output aval by construction.
+    The refinement stage's OWN output must land on the same grid (the
+    prior chain is shape-stable only then) — `_executable`/warmup check
+    that abstractly and reject the config loudly otherwise."""
+    import jax
+
+    params_sds, x_sds = serve_avals(cold_params, bucket, max_batch)
+    out = jax.eval_shape(cold_fwd, params_sds, x_sds)
+    return (int(out.shape[1]), int(out.shape[2]))
+
+
+def refine_serve_avals(refine_params, bucket: tuple[int, int],
+                       max_batch: int, prior_hw: tuple[int, int]):
+    """(params_sds, x_sds, prior_sds) for one warm bucket executable —
+    shared by engine._executable and warmup_serve so their cache keys
+    match (the serve_avals twin, plus the prior input on the cold
+    output's grid — `cold_output_hw`)."""
+    import jax
+
+    params_sds, x_sds = serve_avals(refine_params, bucket, max_batch)
+    prior_sds = jax.ShapeDtypeStruct(
+        (max_batch, prior_hw[0], prior_hw[1], 2), np.float32)
+    return params_sds, x_sds, prior_sds
 
 
 def make_fake_forward(exec_ms: float) -> Callable:
@@ -234,6 +340,10 @@ class InferenceEngine:
                                      DATASET_MEANS["flyingchairs"])
         self.mean = mean
 
+        # temporal warm-start: the refinement-only executable axis
+        # (serve/session.py prior + models/flownet2.py FlowNetRefine)
+        self.warm_start = bool(cfg.serve.session.warm_start)
+
         if (forward_fn is None and model_params is None
                 and cfg.serve.fake_exec_ms is not None):
             # config-driven fake executor: how a fleet replica subprocess
@@ -241,10 +351,13 @@ class InferenceEngine:
             forward_fn = make_fake_forward(float(cfg.serve.fake_exec_ms))
         self._forward_custom = forward_fn is not None
         if self._forward_custom:
-            # internal convention: _forward(key, x) with key =
-            # (bucket, tier); custom executors keep their documented
-            # (bucket, x) signature — they are precision-blind
-            self._forward = lambda key, x, _fn=forward_fn: _fn(key[0], x)
+            # internal convention: _forward(key, x, prior=None) with key
+            # = (bucket, tier, mode); custom executors keep their
+            # documented (bucket, x) signature — they are precision- AND
+            # warm-blind (no weights to quantize, no refinement stage):
+            # warm steps group/count separately but execute the same fn
+            self._forward = (lambda key, x, prior=None, _fn=forward_fn:
+                             _fn(key[0], x))
             self._model = self._params = None
         else:
             if model_params is not None:
@@ -277,6 +390,23 @@ class InferenceEngine:
                 tier: jax.device_put(quantize_params(self._params, tier),
                                      dev)
                 for tier in self.tiers}
+            if self.warm_start:
+                # the warm refinement stage: flownet_cs reuses its own
+                # (restored) refine subtree; other models get the
+                # deterministic seeded gated-residual stage — either
+                # way, one quantized tree per tier, like the cold params
+                self._refine_model = build_refine_model(cfg)
+                if cfg.model == "flownet_cs":
+                    refine_params = {"refine": self._params["refine"]}
+                else:
+                    refine_params = refine_init_params(
+                        cfg, self._refine_model)
+                self._refine_by_tier = {
+                    tier: jax.device_put(
+                        quantize_params(refine_params, tier), dev)
+                    for tier in self.tiers}
+                self._warm_jit = jax.jit(
+                    make_refine_forward(self._refine_model))
             if "f32" not in self.tiers:
                 # nothing reads the f32 tree once the tier trees exist;
                 # keeping it would hold 1-2x the configured ladder's
@@ -305,6 +435,13 @@ class InferenceEngine:
         self._dispatch_failures = 0
         self._bucket_splits = 0
         self._tier_splits = 0
+        self._warm_splits = 0   # same (bucket, tier), cold|warm boundary
+        # temporal warm-start ledger: steps dispatched through the
+        # refinement executable vs warm-eligible steps that fell back
+        # cold (no prior yet — first step, or dropped by re-prime/
+        # rebucket). Both stay 0 with warm_start off.
+        self._warm_steps = 0
+        self._cold_fallbacks = 0
         # per-tier request/response counts (analyze/tail surface these
         # so a tier nobody asks for is visible as such)
         self._requests_by_tier = {t: 0 for t in self.tiers}
@@ -480,17 +617,36 @@ class InferenceEngine:
                                     "frames": s.frames,
                                     "request_id": rid})
                     return fut
-                _, prev_row, s = out
-                span.set(kind="session_step", frame_index=s.frames - 1)
+                _, prev_row, prior, epoch, s = out
+                # temporal warm-start: a step with a cached prior flow
+                # dispatches the refinement-only executable; without one
+                # (first step, or the prior was dropped by a re-prime/
+                # rebucket) it falls back to the full cold network
+                mode = "cold"
+                if self.warm_start and prior is not None:
+                    mode = "warm"
+                    span.set(kind="session_warm",
+                             frame_index=s.frames - 1)
+                else:
+                    if self.warm_start:
+                        with self._stats_lock:
+                            self._cold_fallbacks += 1
+                    span.set(kind="session_step",
+                             frame_index=s.frames - 1)
                 x = np.concatenate([prev_row, row], axis=-1)
             with self._stats_lock:
                 self._requests += 1
                 self._requests_by_tier[tier] += 1
+                if mode == "warm":
+                    self._warm_steps += 1
             counted = True
             self._enqueue(_Request(x, bucket, tier, native_hw, fut,
                                    time.monotonic(), rid,
                                    session=s.sid,
-                                   frame_index=s.frames - 1))
+                                   frame_index=s.frames - 1,
+                                   mode=mode,
+                                   prior=prior if mode == "warm" else None,
+                                   session_epoch=epoch))
         except ServeError as e:
             e.request_id = e.request_id or rid
             if not counted:  # failed frames stay ledgered, exactly once
@@ -573,8 +729,10 @@ class InferenceEngine:
                         with self._stats_lock:
                             if nxt.bucket != batch[0].bucket:
                                 self._bucket_splits += 1
-                            else:  # same shape, different precision
+                            elif nxt.tier != batch[0].tier:
                                 self._tier_splits += 1
+                            else:  # same shape+precision, cold|warm edge
+                                self._warm_splits += 1
                         break
                     batch.append(nxt)
                 # ids are only known once the batch closed: stamp them
@@ -598,9 +756,9 @@ class InferenceEngine:
                     req.rid))
 
     def _flush(self, batch: list[_Request]) -> None:
-        bucket, tier = batch[0].key
+        bucket, tier, mode = batch[0].key
         n = len(batch)
-        tag = f"{bucket[0]}x{bucket[1]}/{tier}"
+        tag = f"{bucket[0]}x{bucket[1]}/{tier}/{mode}"
         rids = [r.rid for r in batch]
         with obs_trace.span("serve_dispatch", occupancy=n, bucket=tag,
                             request_ids=rids):
@@ -608,8 +766,18 @@ class InferenceEngine:
                           batch[0].x.shape[-1]), np.float32)
             for i, r in enumerate(batch):
                 x[i] = r.x
+            prior = None
+            if mode == "warm":
+                # the refinement executable's second input: per-request
+                # priors (finest-head grid — stored dispatch outputs),
+                # zero-padded past the live occupancy like x
+                ph, pw = batch[0].prior.shape[:2]
+                prior = np.zeros((self.max_batch, ph, pw, 2), np.float32)
+                for i, r in enumerate(batch):
+                    prior[i] = r.prior
             try:
-                out = np.asarray(self._forward(batch[0].key, x))
+                out = np.asarray(self._forward(batch[0].key, x,
+                                               prior=prior))
             except Exception as e:  # noqa: BLE001 - the flush fails, not the engine
                 with self._stats_lock:
                     self._dispatch_failures += 1
@@ -628,6 +796,20 @@ class InferenceEngine:
                         "postprocess_failed",
                         f"{type(e).__name__}: {e}", r.rid))
                     continue
+                if r.session is not None and self.warm_start:
+                    # warm-start writeback: this step's raw output
+                    # (finest-head grid, stored VERBATIM — no resample,
+                    # so the untrained residual identity is exact along
+                    # a walk) becomes the session's prior. BEFORE
+                    # set_result — a closed-loop client's next frame
+                    # must observe it — and guarded inside the store
+                    # against re-prime/rebucket/eviction/RESUME races
+                    # (the prime-generation epoch captured at advance).
+                    # The copy detaches the slice from the batch buffer.
+                    self.sessions.set_flow(
+                        r.session,
+                        np.ascontiguousarray(out[i], np.float32), bucket,
+                        r.session_epoch)
                 done = time.monotonic()
                 self._hist.observe(done - r.t_enq)
                 if r.session is not None:
@@ -651,6 +833,10 @@ class InferenceEngine:
                 if r.session is not None:
                     result["session"] = r.session
                     result["frame_index"] = r.frame_index
+                    if self.warm_start:
+                        # only under the toggle: warm_start=false keeps
+                        # the PR 10 response schema byte-identical
+                        result["warm"] = r.mode == "warm"
                 r.future.set_result(result)
         with self._stats_lock:
             self._batches += 1
@@ -665,29 +851,65 @@ class InferenceEngine:
                 pass
 
     # ---------------------------------------------------------- forward
-    def _model_forward(self, key: tuple[tuple[int, int], str],
-                       x: np.ndarray):
-        return self._executable(key)(self._params_by_tier[key[1]], x)
+    def _model_forward(self, key: tuple[tuple[int, int], str, str],
+                       x: np.ndarray, prior: np.ndarray | None = None):
+        bucket, tier, mode = key
+        if mode == "warm":
+            return self._executable(key)(self._refine_by_tier[tier], x,
+                                         prior)
+        return self._executable(key)(self._params_by_tier[tier], x)
 
-    def _executable(self, key: tuple[tuple[int, int], str]):
-        """The (bucket, tier) pair's AOT-compiled forward, compiled (or
-        loaded from the persistent cache — the `warmup --serve`
-        contract) on first use."""
+    def _executable(self, key: tuple[tuple[int, int], str, str]):
+        """The (bucket, tier, mode) triple's AOT-compiled forward —
+        cold: the full network, warm: the refinement-only stage —
+        compiled (or loaded from the persistent cache — the
+        `warmup --serve` contract) on first use."""
         with self._compile_lock:
             c = self._compiled.get(key)
             if c is None:
-                bucket, tier = key
-                params_sds, x_sds = serve_avals(self._params_by_tier[tier],
-                                                bucket, self.max_batch)
-                c = self._jit.lower(params_sds, x_sds).compile()
+                bucket, tier, mode = key
+                if mode == "warm":
+                    import jax
+
+                    prior_hw = cold_output_hw(
+                        self._jit, self._params_by_tier[tier], bucket,
+                        self.max_batch)
+                    params_sds, x_sds, prior_sds = refine_serve_avals(
+                        self._refine_by_tier[tier], bucket,
+                        self.max_batch, prior_hw)
+                    # the prior chain must be shape-stable: after the
+                    # first warm step the stored prior is the REFINE
+                    # stage's output, so its grid must equal the cold
+                    # head grid the executable was lowered for — check
+                    # abstractly HERE (warm()/first use), not as a
+                    # poisoned dispatch three steps in
+                    out_sds = jax.eval_shape(self._warm_jit, params_sds,
+                                             x_sds, prior_sds)
+                    if tuple(out_sds.shape[1:3]) != tuple(prior_hw):
+                        raise ValueError(
+                            f"warm_start unsupported for model "
+                            f"{self.cfg.model!r} at bucket {bucket}: the "
+                            f"refinement head grid "
+                            f"{tuple(out_sds.shape[1:3])} differs from "
+                            f"the cold head grid {tuple(prior_hw)} — the "
+                            f"session's prior would change shape after "
+                            f"the first warm step")
+                    c = self._warm_jit.lower(params_sds, x_sds,
+                                             prior_sds).compile()
+                else:
+                    params_sds, x_sds = serve_avals(
+                        self._params_by_tier[tier], bucket, self.max_batch)
+                    c = self._jit.lower(params_sds, x_sds).compile()
                 self._compiled[key] = c
         return c
 
     def warm(self) -> dict:
-        """AOT-compile every configured (bucket, tier) pair now (server
-        startup / offline-mode entry), through the persistent compile
-        cache when active — after `warmup --serve` these are loads, not
-        compiles. Returns per-pair timings + the cache hit/miss delta."""
+        """AOT-compile every configured (bucket, tier, mode) triple now
+        (server startup / offline-mode entry), through the persistent
+        compile cache when active — after `warmup --serve` these are
+        loads, not compiles. The mode axis exists only under
+        serve.session.warm_start. Returns per-entry timings + the cache
+        hit/miss delta."""
         # the postprocess import chain (train/evaluate and friends) is
         # first-request latency too — ~seconds in a fresh process, paid
         # inside the batcher thread if not paid here (measured via
@@ -698,15 +920,18 @@ class InferenceEngine:
             return {"buckets": [], "cache": None}  # nothing to compile
         from ..train.warmup import cache_delta
 
-        out: dict = {"buckets": []}
+        modes = ("cold", "warm") if self.warm_start else ("cold",)
+        out: dict = {"buckets": [], "modes": list(modes)}
         with cache_delta() as d:
             for b in self.buckets:
                 for tier in self.tiers:
-                    t0 = time.perf_counter()
-                    self._executable((b, tier))
-                    out["buckets"].append(
-                        {"bucket": list(b), "tier": tier,
-                         "compile_s": round(time.perf_counter() - t0, 3)})
+                    for mode in modes:
+                        t0 = time.perf_counter()
+                        self._executable((b, tier, mode))
+                        out["buckets"].append(
+                            {"bucket": list(b), "tier": tier, "mode": mode,
+                             "compile_s": round(
+                                 time.perf_counter() - t0, 3)})
         out["cache"] = d.stats()
         return out
 
@@ -731,6 +956,7 @@ class InferenceEngine:
                 "serve_dispatch_failures": self._dispatch_failures,
                 "serve_bucket_splits": self._bucket_splits,
                 "serve_tier_splits": self._tier_splits,
+                "serve_warm_splits": self._warm_splits,
                 "serve_requests_by_tier": dict(self._requests_by_tier),
                 "serve_responses_by_tier": dict(self._responses_by_tier),
                 "serve_timeout_flushes": self._timeout_flushes,
@@ -758,6 +984,13 @@ class InferenceEngine:
         # buckets — obs/export.py percentile_ms — so the figure an
         # operator sees here matches what a fleet-level merge would say)
         out.update(self.sessions.stats())
+        # temporal warm-start ledger (engine-owned: the warm/cold
+        # decision happens at submit, not in the store); rides the
+        # serve_sessions_* block through heartbeat/metrics/analyze/tail
+        with self._stats_lock:
+            out["serve_sessions_warm_steps"] = self._warm_steps
+            out["serve_sessions_cold_fallbacks"] = self._cold_fallbacks
+        out["serve_sessions_warm_start"] = self.warm_start
         shist = self._session_hist.snapshot()
         out["serve_session_latency_hist"] = shist
         out["serve_session_latency_p50_ms"] = percentile_ms(shist, 0.50)

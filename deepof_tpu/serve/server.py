@@ -37,7 +37,9 @@ API:
     202 {"primed": true, "session", "bucket", "frames"}: the frame
         opened (or re-opened) the session; no pair yet.
     200 the same payload as /v1/flow for the (previous, this) pair,
-        plus {"session", "frame_index"} — one decode per frame.
+        plus {"session", "frame_index"} — one decode per frame — and,
+        when serve.session.warm_start is on, {"warm": bool}: whether
+        the step rode the refinement-only warm executable.
     410 {"error": "session_expired"}: the session was TTL-expired or
         LRU-evicted; resend the frame to re-prime.
   DELETE /v1/flow/stream/<id> -> 200 {"session", "deleted": true} |
@@ -100,7 +102,9 @@ def install_replica_faults(engine: InferenceEngine,
     after = max(int(cfg.resilience.faults.replica_fault_after), 0)
     inner = engine._forward
 
-    def forward(bucket, x):
+    def forward(key, x, *args, **kw):
+        # signature-transparent: the engine calls _forward(key, x) on
+        # the cold path and _forward(key, x, prior=...) on the warm one
         with engine._stats_lock:
             done = engine._responses
         if done >= after:
@@ -108,7 +112,7 @@ def install_replica_faults(engine: InferenceEngine,
                 os.kill(os.getpid(), signal.SIGKILL)
             if inj.hit("replica_wedge", idx):
                 threading.Event().wait()  # never returns: wedged dispatch
-        return inner(bucket, x)
+        return inner(key, x, *args, **kw)
 
     engine._forward = forward
 
@@ -283,6 +287,11 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 if stream:
                     payload["session"] = res["session"]
                     payload["frame_index"] = res["frame_index"]
+                    if "warm" in res:
+                        # temporal warm-start provenance (present only
+                        # when serve.session.warm_start is on): whether
+                        # this step rode the refinement-only executable
+                        payload["warm"] = res["warm"]
                 self._reply_json(200, payload)
 
         def do_DELETE(self):  # noqa: N802

@@ -8,8 +8,14 @@ module keeps, per session id, the last frame's decoded +
 bucket-preprocessed half-row (serve/buckets.py prepare_frame), so
 `engine.submit_next(session, frame)` forms the (prev, next) pair
 server-side from ONE new frame — one decode and one preprocess per
-frame, halving host work for video and opening temporal warm-start
-(FlowNet 2.0 lineage, PAPERS.md).
+frame, halving host work for video — plus, since r11, the last step's
+RESOLVED FLOW (raw dispatch output at the bucket's finest-head grid,
+stored verbatim): the temporal warm-start prior
+(FlowNet 2.0 lineage, PAPERS.md) the engine's refinement-only
+executable consumes when `serve.session.warm_start` is on. The prior
+is engine-written (set_flow, guarded on liveness + bucket match) and
+dropped on EVERY re-prime/rebucket, so a warm step can never refine
+against a stale or mis-sized flow.
 
 Contract decisions that matter:
 
@@ -63,7 +69,7 @@ TOMBSTONE_CAP = 4096
 
 class _Session:
     __slots__ = ("sid", "row", "bucket", "native_hw", "tier", "frames",
-                 "last_m")
+                 "last_m", "flow", "epoch")
 
     def __init__(self, sid, row, bucket, native_hw, tier, now):
         self.sid = sid
@@ -73,6 +79,21 @@ class _Session:
         self.tier = tier            # default precision for this session's steps
         self.frames = 1
         self.last_m = now
+        # the newest resolved flow for this session — the raw dispatch
+        # output at the bucket's finest-head grid (h, w, 2) f32, stored
+        # VERBATIM — the temporal warm-start prior (set by engine
+        # set_flow after a step's dispatch resolves; None until the
+        # first step's flow lands). Dropped to None on every
+        # re-prime/rebucket: a stale or wrong-resolution flow can never
+        # leak into a refinement input.
+        self.flow = None
+        # prime-generation id (store-wide monotonic, assigned by the
+        # store at every prime/re-prime/rebucket): the set_flow guard's
+        # identity token. A dispatch captures the epoch inside advance()
+        # and its writeback is dropped unless the session is STILL that
+        # generation — a tombstone-resume at the same sid + bucket
+        # cannot accept a pre-eviction flow.
+        self.epoch = 0
 
 
 class SessionExpired(KeyError):
@@ -112,6 +133,7 @@ class SessionStore:
         self._rebucketed = 0  # mid-session resolution change re-primes
         self._frames = 0      # every accepted frame (primes + steps)
         self._steps = 0       # frames that formed a pair from the cache
+        self._epoch = 0       # prime-generation counter (_Session.epoch)
         self._stop = threading.Event()
         self._sweeper = None
         if self.ttl_s > 0 and float(sweep_s) > 0:
@@ -149,12 +171,17 @@ class SessionStore:
 
         Returns ("primed", session) when the frame opens (or re-opens)
         the session — no pair to dispatch — or ("step", prev_row,
-        session) with the PREVIOUS frame's half-row: the caller forms
-        the (prev, next) network input by channel concat. The stored
-        frame advances to `row` either way. Raises SessionExpired when
-        `sid` is tombstoned (evicted/TTL-expired): the structured
-        `session_expired` path — the client re-primes, and that re-prime
-        clears the tombstone and counts as `resumed`.
+        prior_flow, epoch, session) with the PREVIOUS frame's half-row
+        (the caller forms the (prev, next) network input by channel
+        concat), the session's cached flow (None until a step's flow
+        has landed via set_flow — the temporal warm-start prior; a None
+        prior means the caller dispatches cold), and the session's
+        prime-generation epoch (the token set_flow requires). The
+        stored frame advances to `row` either way; a RE-PRIME (fresh,
+        resumed, or rebucketed) always drops the cached flow. Raises
+        SessionExpired when `sid` is tombstoned (evicted/TTL-expired):
+        the structured `session_expired` path — the client re-primes,
+        and that re-prime clears the tombstone and counts as `resumed`.
         """
         now = time.monotonic()
         with self._lock:
@@ -181,6 +208,8 @@ class SessionStore:
                 else:
                     self._created += 1
                 s = _Session(sid, row, bucket, native_hw, tier, now)
+                self._epoch += 1
+                s.epoch = self._epoch
                 self._sessions[sid] = s
                 self._sessions.move_to_end(sid)
                 while len(self._sessions) > self.max_sessions:
@@ -189,15 +218,23 @@ class SessionStore:
                 return ("primed", s)
             if s.bucket != tuple(bucket):
                 # resolution changed mid-session: the cached half-row is
-                # at the old bucket shape — re-prime in place, loudly
+                # at the old bucket shape — re-prime in place, loudly.
+                # The cached flow is at the old bucket's resolution too:
+                # drop it, or a later warm step would refine against a
+                # mis-sized prior.
                 self._rebucketed += 1
                 s.row, s.bucket = row, tuple(bucket)
                 s.native_hw, s.tier = tuple(native_hw), tier
+                s.flow = None
+                self._epoch += 1
+                s.epoch = self._epoch  # new generation: in-flight
+                # writebacks from before the rebucket are now orphans
                 s.frames += 1
                 s.last_m = now
                 self._sessions.move_to_end(sid)
                 return ("primed", s)
             prev = s.row
+            prior = s.flow
             s.row = row
             s.native_hw = tuple(native_hw)
             s.tier = tier
@@ -205,7 +242,26 @@ class SessionStore:
             s.last_m = now
             self._steps += 1
             self._sessions.move_to_end(sid)
-            return ("step", prev, s)
+            return ("step", prev, prior, s.epoch, s)
+
+    def set_flow(self, sid: str, flow: np.ndarray,
+                 bucket: tuple[int, int], epoch: int) -> bool:
+        """Record a resolved step's raw flow output (the bucket's
+        finest-head grid) as the session's warm-start prior. Guarded:
+        the session must still be live, still at `bucket`, AND still
+        the same prime generation (`epoch`, captured inside the
+        advance() that formed the step) — a session that was re-primed,
+        rebucketed, evicted, or tombstone-RESUMED while the dispatch
+        was in flight silently drops the write (False), so a stale or
+        wrong-resolution flow can never become a refinement input. No
+        LRU/TTL touch: this is engine bookkeeping, not client activity."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if (s is None or s.bucket != tuple(bucket)
+                    or s.epoch != int(epoch)):
+                return False
+            s.flow = flow
+            return True
 
     def delete(self, sid: str) -> bool:
         """Explicit session end (DELETE /v1/flow/stream/<id>). No

@@ -259,14 +259,18 @@ def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
 def warmup_serve(cfg: ExperimentConfig) -> dict:
     """AOT-compile the serve ladder into the persistent cache
     (`warmup --serve`): one inference executable per configured
-    (shape bucket, precision tier) pair, lowered exactly as
-    `serve/engine.py:_executable` lowers at runtime (shared
-    `make_raw_forward` + `serve_avals`, tier params avals derived
-    through the same `quantize_params` transform — abstractly, via
-    eval_shape), so a later engine's first request per (bucket, tier)
-    LOADS instead of compiling — zero first-request XLA across the
-    whole bucket x tier ladder (pinned in tests/test_serve.py and
-    tests/test_quant.py).
+    (shape bucket, precision tier, dispatch mode) entry, lowered
+    exactly as `serve/engine.py:_executable` lowers at runtime (shared
+    `make_raw_forward`/`make_refine_forward` + `serve_avals`/
+    `refine_serve_avals`, tier params avals derived through the same
+    `quantize_params` transform — abstractly, via eval_shape), so a
+    later engine's first request per (bucket, tier, mode) LOADS instead
+    of compiling — zero first-request XLA across the whole lattice
+    (pinned in tests/test_serve.py, tests/test_quant.py and
+    tests/test_warm.py). The mode axis ({cold} or {cold, warm}) follows
+    `serve.session.warm_start`: a warm-enabled config's FIRST warm step
+    — the temporal warm-start refinement executable — is pre-lowered
+    next to its cold siblings.
 
     No checkpoint needed: params enter as ShapeDtypeStructs from an
     eval_shape of model.init — warmup compiles executables for a
@@ -286,21 +290,30 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     import jax.numpy as jnp
 
     from ..serve.buckets import resolve_buckets
-    from ..serve.engine import (PAIR_CHANNELS, build_serve_model,
-                                make_raw_forward, serve_avals)
+    from ..serve.engine import (PAIR_CHANNELS, build_refine_model,
+                                build_serve_model, cold_output_hw,
+                                make_raw_forward, make_refine_forward,
+                                refine_serve_avals, serve_avals)
     from ..serve.quant import quantize_params, resolve_precisions
 
     enable_for_config(cfg)
     model = build_serve_model(cfg)
     buckets = resolve_buckets(cfg)
     tiers = resolve_precisions(cfg)
+    modes = (("cold", "warm") if cfg.serve.session.warm_start
+             else ("cold",))
     max_batch = max(cfg.serve.max_batch, 1)
     fwd = jax.jit(make_raw_forward(model))
+    refine_model = refine_fwd = None
+    if "warm" in modes:
+        refine_model = build_refine_model(cfg)
+        refine_fwd = jax.jit(make_refine_forward(refine_model))
 
     out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
                            "backend": jax.default_backend(),
                            "cache_dir": jax.config.jax_compilation_cache_dir,
                            "tiers": list(tiers),
+                           "modes": list(modes),
                            "buckets": []}
     # everything inside the delta must be the bucket executables and
     # nothing else: abstract init (eval_shape over ShapeDtypeStructs
@@ -321,34 +334,70 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
             variables_sds = jax.eval_shape(
                 model.init, key_sds,
                 jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS), jnp.float32))
+            refine_vars_sds = None
+            if refine_model is not None:
+                # the refinement stage's params AVALS, abstractly: for
+                # flownet_cs this equals the checkpoint's `refine`
+                # subtree by construction (same module, same scope);
+                # for other models it matches the engine's seeded init
+                refine_vars_sds = jax.eval_shape(
+                    refine_model.init, key_sds,
+                    jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS),
+                                         jnp.float32),
+                    jax.ShapeDtypeStruct((1, h, w, 2), jnp.float32))
             for tier in tiers:
                 # the tier's params AVALS through the same transform the
                 # engine applies to real weights — abstract, so no
                 # weight bytes materialize and no helper compiles leak
                 # into the delta
-                tier_params_sds = jax.eval_shape(
+                cold_tier_sds = jax.eval_shape(
                     lambda p, _t=tier: quantize_params(p, _t),
                     variables_sds["params"])
-                params_sds, x_sds = serve_avals(tier_params_sds, bucket,
-                                                max_batch)
-                before_files = _entries()
-                bucket_delta = cache_delta()
-                t0 = time.perf_counter()
-                fwd.lower(params_sds, x_sds).compile()
-                bd = bucket_delta.stats()
-                # persisted = a new on-disk entry appeared (filesystem
-                # truth, not the counter's hope) OR the compile was
-                # already a hit (the entry predates this call). Neither
-                # => the 1 s floor swallowed it: compiled fine,
-                # persisted nothing.
-                wrote = bool(_entries() - before_files)
-                persisted = wrote or bd["hits"] >= 1
-                out["buckets"].append(
-                    {"bucket": [h, w], "tier": tier,
-                     "compile_s": round(time.perf_counter() - t0, 3),
-                     "persisted": persisted,
-                     "status": ("hit" if bd["hits"] >= 1
-                                else "persisted" if wrote else "skipped")})
+                for mode in modes:
+                    before_files = _entries()
+                    bucket_delta = cache_delta()
+                    t0 = time.perf_counter()
+                    if mode == "cold":
+                        params_sds, x_sds = serve_avals(
+                            cold_tier_sds, bucket, max_batch)
+                        fwd.lower(params_sds, x_sds).compile()
+                    else:
+                        refine_tier_sds = jax.eval_shape(
+                            lambda p, _t=tier: quantize_params(p, _t),
+                            refine_vars_sds["params"])
+                        prior_hw = cold_output_hw(fwd, cold_tier_sds,
+                                                  bucket, max_batch)
+                        params_sds, x_sds, prior_sds = refine_serve_avals(
+                            refine_tier_sds, bucket, max_batch, prior_hw)
+                        # mirror the engine's prior-chain shape check:
+                        # a config the engine would reject must fail
+                        # warmup identically, not silently pre-compile
+                        out_sds = jax.eval_shape(refine_fwd, params_sds,
+                                                 x_sds, prior_sds)
+                        if tuple(out_sds.shape[1:3]) != tuple(prior_hw):
+                            raise ValueError(
+                                f"warm_start unsupported for model "
+                                f"{cfg.model!r} at bucket {bucket}: "
+                                f"refinement head grid "
+                                f"{tuple(out_sds.shape[1:3])} != cold "
+                                f"head grid {tuple(prior_hw)}")
+                        refine_fwd.lower(params_sds, x_sds,
+                                         prior_sds).compile()
+                    bd = bucket_delta.stats()
+                    # persisted = a new on-disk entry appeared
+                    # (filesystem truth, not the counter's hope) OR the
+                    # compile was already a hit (the entry predates this
+                    # call). Neither => the 1 s floor swallowed it:
+                    # compiled fine, persisted nothing.
+                    wrote = bool(_entries() - before_files)
+                    persisted = wrote or bd["hits"] >= 1
+                    out["buckets"].append(
+                        {"bucket": [h, w], "tier": tier, "mode": mode,
+                         "compile_s": round(time.perf_counter() - t0, 3),
+                         "persisted": persisted,
+                         "status": ("hit" if bd["hits"] >= 1
+                                    else "persisted" if wrote
+                                    else "skipped")})
     out["cache"] = d.stats()
     out["persisted_buckets"] = sum(b["persisted"] for b in out["buckets"])
     out["skipped_buckets"] = sum(not b["persisted"] for b in out["buckets"])

@@ -579,16 +579,20 @@ def _load_serve_bench():
 
 
 def test_serve_bench_stream_speedup_and_schema():
-    """ISSUE 10 acceptance: on a decode-bound walk (20 ms injected
+    """ISSUE 10 + 11 acceptance: on a decode-bound walk (20 ms injected
     decode, 2 ms executor) the streamed session sustains >= 1.5x the
-    pairwise walk's frames/s with bit-identical flows; the JSON schema
-    is pinned. One bounded retry on the timing ratio (scheduler spikes
-    on this small host); the schema and parity assert strictly every
-    time."""
+    pairwise walk's frames/s with bit-identical flows, AND the
+    real-model temporal warm-start block reports warm_speedup >= 1.3
+    (refinement-only executable vs the full cold network) inside the
+    epe_vs_cold <= 0.5 px quality gate; the JSON schema is pinned. One
+    bounded retry on the timing ratios (scheduler spikes on this small
+    host); the schema, parity, ledger, and quality gates assert
+    strictly every time."""
     sb = _load_serve_bench()
     for attempt in range(2):
         res = sb.stream_bench(frames=32, decode_ms=20.0, exec_ms=2.0,
-                              max_batch=4, timeout_ms=2.0)
+                              max_batch=4, timeout_ms=2.0,
+                              warm_frames=12)
         for key in sb.STREAM_REQUIRED_KEYS:
             assert key in res, f"stream result missing {key!r}"
         json.dumps(res)  # JSON-line contract
@@ -598,9 +602,15 @@ def test_serve_bench_stream_speedup_and_schema():
         assert res["stream_decodes"] == 32
         assert res["pairwise_decodes"] == 62
         assert res["decode_saved"] == 31
-        if res["stream_speedup"] >= 1.5:
+        # warm-start structure + quality gate: strict every attempt
+        assert res["warm_errors"] == 0
+        assert res["warm_steps"] == 10  # 12 frames: prime, fallback, 10
+        assert res["warm_cold_fallbacks"] == 1
+        assert res["epe_vs_cold"] <= 0.5, res
+        if res["stream_speedup"] >= 1.5 and res["warm_speedup"] >= 1.3:
             break
     assert res["stream_speedup"] >= 1.5, res
+    assert res["warm_speedup"] >= 1.3, res
 
 
 # ------------------------------------------------ chaos (subprocess)

@@ -1,0 +1,486 @@
+"""Temporal warm-start tests (ISSUE 11, DESIGN.md "Temporal warm-start").
+
+Unit tier (fake executor / store-level, no jax): the prior-flow
+lifecycle — set only via the guarded engine writeback, handed to warm
+steps, DROPPED on tombstone re-prime and mid-session rebucket so a
+410-resume or resolution change dispatches cold, never refines against
+stale/mis-sized flow; warm batching (a warm step and a cold request
+never share a flush); `SessionConfig` round-trip + unknown-`warm_start`
+-typo rejection at every nesting level; observability surfacing
+(stats -> /metrics -> heartbeat/tail -> analyze merge, with the per-key
+histogram merge pinned alongside the new counters).
+
+Real-model tier: warm-path output deterministic and bit-stable across
+repeated dispatches AND across engines (seeded refinement init);
+`warm_start=false` flows bitwise-identical to the pairwise walk (the
+PR 10 contract, unchanged); `epe_vs_cold` within the quality gate on a
+coherent walk; `warmup --serve` report covers the bucket x tier x
+{cold, warm} lattice.
+
+Slow tier: the PR 7-style zero-recompile acceptance extended to the
+warm axis — after `warmup --serve` on a warm-enabled config, a cold
+engine's first WARM request loads its executable (report-driven:
+misses <= skipped).
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from conftest import wait_for_listen
+
+from deepof_tpu.core.config import config_from_dict, get_config
+from deepof_tpu.serve.engine import (InferenceEngine, ServeError,
+                                     make_fake_forward)
+from deepof_tpu.serve.session import SessionExpired, SessionStore
+
+# ----------------------------------------------------------- helpers
+
+
+def _cfg(max_batch=4, timeout_ms=5.0, buckets=(), image_size=(32, 64),
+         log_dir="/tmp/deepof_warm_test", session_kw=None, **serve_kw):
+    cfg = get_config("flyingchairs")
+    session = dataclasses.replace(cfg.serve.session, warm_start=True)
+    if session_kw:
+        session = dataclasses.replace(session, **session_kw)
+    return cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=image_size, gt_size=image_size),
+        serve=dataclasses.replace(cfg.serve, max_batch=max_batch,
+                                  batch_timeout_ms=timeout_ms,
+                                  buckets=buckets, session=session,
+                                  **serve_kw),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6), log_dir=log_dir))
+
+
+def _img(rng, hw=(30, 60)):
+    return rng.randint(1, 255, (*hw, 3), dtype=np.uint8)
+
+
+_SERVE_BENCH = None
+
+
+def _serve_bench():
+    """tools/serve_bench.py, loaded once: the unit tier reuses the
+    benchmark's OWN helpers (coherent walk, real-model init) so it
+    measures exactly the workload the warm bench pins."""
+    global _SERVE_BENCH
+    if _SERVE_BENCH is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "serve_bench.py")
+        spec = importlib.util.spec_from_file_location("serve_bench_warm",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _SERVE_BENCH = mod
+    return _SERVE_BENCH
+
+
+def _coherent(rng, n, hw=(30, 60)):
+    return _serve_bench()._coherent_walk(rng, hw, n)
+
+
+def _row(rng, hw=(4, 4)):
+    return rng.rand(*hw, 3).astype(np.float32)
+
+
+# ------------------------------------------------------ SessionStore
+
+
+def test_store_prior_flow_lifecycle(rng):
+    """The prior is None until set_flow lands, rides later steps, and is
+    dropped by rebucket; set_flow is guarded on liveness, bucket, AND
+    prime-generation epoch."""
+    store = SessionStore(max_sessions=4, ttl_s=0, sweep_s=0)
+    store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    kind, _, prior, epoch, _ = store.advance("v", _row(rng), (4, 4),
+                                             (4, 4), "f32")
+    assert kind == "step" and prior is None  # first step: nothing cached
+
+    flow = np.ones((2, 2, 2), np.float32)
+    assert store.set_flow("v", flow, (4, 4), epoch) is True
+    out = store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    assert out[0] == "step" and np.array_equal(out[2], flow)
+
+    # wrong-bucket writeback (a rebucket raced the dispatch): dropped
+    assert store.set_flow("v", flow, (8, 8), epoch) is False
+    # wrong-generation writeback: dropped
+    assert store.set_flow("v", flow, (4, 4), epoch + 99) is False
+    # dead-session writeback: dropped
+    assert store.set_flow("ghost", flow, (4, 4), epoch) is False
+
+    # mid-session rebucket re-primes AND drops the cached flow
+    store.set_flow("v", flow, (4, 4), epoch)
+    kind, s = store.advance("v", _row(rng, (8, 8)), (8, 8), (8, 8), "f32")
+    assert kind == "primed" and s.flow is None
+    out = store.advance("v", _row(rng, (8, 8)), (8, 8), (8, 8), "f32")
+    assert out[0] == "step" and out[2] is None  # cold again, by construction
+    # a straggler writeback from the OLD generation (same sid, the old
+    # bucket) cannot land on the rebucketed session
+    assert store.set_flow("v", flow, (4, 4), epoch) is False
+    store.close()
+
+
+def test_store_tombstone_resume_drops_prior_and_rejects_stragglers(rng):
+    """A TTL-expired session's re-prime (the 410-resume) starts with no
+    prior — the resumed session's first step must dispatch cold — and a
+    dispatch that was in flight ACROSS the expiry cannot write its flow
+    into the resumed session (same sid, same bucket: only the epoch
+    tells them apart)."""
+    store = SessionStore(max_sessions=4, ttl_s=0.15, sweep_s=0)
+    store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    out = store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    old_epoch = out[3]
+    store.set_flow("v", np.ones((2, 2, 2), np.float32), (4, 4), old_epoch)
+    time.sleep(0.25)
+    with pytest.raises(SessionExpired):
+        store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    kind, s = store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    assert kind == "primed" and s.flow is None
+    assert store.stats()["serve_sessions_resumed"] == 1
+    # the pre-expiry dispatch resolves late: same sid, same bucket —
+    # dropped on the epoch guard, so the resumed session stays cold
+    assert store.set_flow("v", np.ones((2, 2, 2), np.float32),
+                          (4, 4), old_epoch) is False
+    out = store.advance("v", _row(rng), (4, 4), (4, 4), "f32")
+    assert out[0] == "step" and out[2] is None
+    store.close()
+
+
+# ------------------------------------------------- engine (fake exec)
+
+
+def test_engine_warm_fake_counters_parity_and_off_schema(rng):
+    """Fake-executor warm engine: the executor is warm-blind, so warm
+    flows are bitwise the cold engine's AND the pairwise path's — the
+    warm axis changes dispatch routing and bookkeeping, never numerics,
+    for custom executors. Counters: 1 cold fallback then warm steps;
+    the `warm` response flag appears ONLY under the toggle (the
+    warm_start=false response schema is the PR 10 one, unchanged)."""
+    frames = [_img(rng) for _ in range(6)]
+    with InferenceEngine(_cfg(), forward_fn=make_fake_forward(1.0)) as eng:
+        pairwise = [eng.submit(a, b).result(30)["flow"]
+                    for a, b in zip(frames, frames[1:])]
+        eng.submit_next("vid", frames[0]).result(30)
+        streamed = [eng.submit_next("vid", f).result(30)
+                    for f in frames[1:]]
+        stats = eng.stats()
+    assert [st["warm"] for st in streamed] == [False, True, True, True, True]
+    for i, (pw, st) in enumerate(zip(pairwise, streamed)):
+        assert np.array_equal(pw, st["flow"]), f"pair {i} diverged"
+    assert stats["serve_sessions_warm_steps"] == 4
+    assert stats["serve_sessions_cold_fallbacks"] == 1
+    assert stats["serve_sessions_warm_start"] is True
+    assert stats["serve_warm_splits"] >= 0  # schema: the key exists
+
+    cfg_off = _cfg(session_kw=dict(warm_start=False))
+    with InferenceEngine(cfg_off, forward_fn=make_fake_forward(1.0)) as eng:
+        eng.submit_next("vid", frames[0]).result(30)
+        off = [eng.submit_next("vid", f).result(30) for f in frames[1:]]
+        stats = eng.stats()
+    for i, (pw, st) in enumerate(zip(pairwise, off)):
+        assert np.array_equal(pw, st["flow"]), f"off pair {i} diverged"
+    assert all("warm" not in st for st in off)  # PR 10 schema exactly
+    assert stats["serve_sessions_warm_steps"] == 0
+    assert stats["serve_sessions_cold_fallbacks"] == 0
+    assert stats["serve_sessions_warm_start"] is False
+
+
+def test_engine_warm_step_never_shares_a_flush_with_cold(rng):
+    """A warm step and a cold request queued together split the batch
+    (the tier-switch contract extended to the mode axis): counted as
+    serve_warm_splits, and both still resolve."""
+    cfg = _cfg(max_batch=4, timeout_ms=60.0)
+    frames = [_img(rng) for _ in range(3)]
+    with InferenceEngine(cfg, forward_fn=make_fake_forward(25.0)) as eng:
+        eng.submit_next("v", frames[0]).result(30)
+        eng.submit_next("v", frames[1]).result(30)  # seeds the prior
+        # the next step is warm; enqueue a cold pairwise request right
+        # behind it inside the batching window — same bucket, same tier,
+        # different mode: must flush separately
+        f_warm = eng.submit_next("v", frames[2])
+        f_cold = eng.submit(frames[1], frames[2])
+        assert f_warm.result(30)["warm"] is True
+        assert "flow" in f_cold.result(30)
+        stats = eng.stats()
+    assert stats["serve_warm_splits"] >= 1, stats
+    assert stats["serve_sessions_warm_steps"] == 1
+
+
+def test_engine_warm_rebucket_and_expiry_fall_back_cold(rng):
+    """Engine-level pins of the two drop paths: a mid-session rebucket
+    and a tombstone re-prime each force the NEXT step cold (counted),
+    even though earlier steps were warming."""
+    cfg = _cfg(buckets=((32, 64), (64, 64)),
+               session_kw=dict(ttl_s=0.2, sweep_s=0.0))
+    small = [_img(rng, (30, 60)) for _ in range(3)]
+    big = [_img(rng, (60, 60)) for _ in range(3)]
+    with InferenceEngine(cfg, forward_fn=make_fake_forward(1.0)) as eng:
+        eng.submit_next("v", small[0]).result(30)
+        assert eng.submit_next("v", small[1]).result(30)["warm"] is False
+        assert eng.submit_next("v", small[2]).result(30)["warm"] is True
+        # resolution change: re-prime in place, prior dropped
+        assert eng.submit_next("v", big[0]).result(30)["primed"] is True
+        r = eng.submit_next("v", big[1]).result(30)
+        assert r["warm"] is False  # cold fallback after rebucket
+        assert eng.submit_next("v", big[2]).result(30)["warm"] is True
+        stats = eng.stats()
+        assert stats["serve_sessions_cold_fallbacks"] == 2
+        assert stats["serve_sessions_warm_steps"] == 2
+
+        time.sleep(0.4)  # TTL: tombstone, then 410-style resume
+        with pytest.raises(ServeError) as exc:
+            eng.submit_next("v", big[0]).result(30)
+        assert exc.value.code == "session_expired"
+        assert eng.submit_next("v", big[0]).result(30)["primed"] is True
+        r = eng.submit_next("v", big[1]).result(30)
+        assert r["warm"] is False  # resumed session starts cold
+        stats = eng.stats()
+        assert stats["serve_sessions_cold_fallbacks"] == 3
+        assert stats["serve_sessions_resumed"] == 1
+
+
+# ------------------------------------------------------------ config
+
+
+def test_warm_config_round_trip_and_typo_rejection_every_level():
+    """The parent->replica handoff covers the warm knobs, and an
+    unknown `warm_start` typo is rejected loudly at EVERY nesting
+    level — a typo'd toggle must never silently stay off."""
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, session=dataclasses.replace(
+            cfg.serve.session, warm_start=True, warm_width=0.25)))
+    restored = config_from_dict(json.loads(json.dumps(
+        dataclasses.asdict(cfg))))
+    assert restored == cfg
+    assert restored.serve.session.warm_start is True
+    assert restored.serve.session.warm_width == 0.25
+    with pytest.raises(ValueError, match="session"):
+        config_from_dict({"serve": {"session": {"warm_stat": True}}})
+    with pytest.raises(ValueError, match="serve"):
+        config_from_dict({"serve": {"session_warm_start": True}})
+    with pytest.raises(ValueError, match="warm_start"):
+        config_from_dict({"warm_start": True})
+
+
+# ----------------------------------------------------- observability
+
+
+def test_warm_counters_on_metrics_healthz_tail_and_analyze(rng, tmp_path):
+    """The warm ledger rides every existing surface: engine stats ->
+    /healthz + Prometheus /metrics (generic render), heartbeat -> tail,
+    and analyze's merged child aggregation (counters sum; the per-key
+    histogram merge keeps working with the new keys present)."""
+    import http.client
+
+    from deepof_tpu.analyze import aggregate_processes, tail_summary
+    from deepof_tpu.obs.export import LatencyHistogram, parse_prometheus
+    from deepof_tpu.serve.server import build_server
+
+    cfg = _cfg(port=0, log_dir=str(tmp_path))
+    frames = [_img(rng) for _ in range(4)]
+    eng = InferenceEngine(cfg, forward_fn=make_fake_forward(1.0))
+    httpd = build_server(cfg, eng)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    port = httpd.server_address[1]
+    wait_for_listen("127.0.0.1", port)
+    try:
+        eng.submit_next("v", frames[0]).result(30)
+        for f in frames[1:]:
+            eng.submit_next("v", f).result(30)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        samples = parse_prometheus(text)
+        assert samples["deepof_serve_sessions_warm_steps"] == 2.0
+        assert samples["deepof_serve_sessions_cold_fallbacks"] == 1.0
+        assert samples["deepof_serve_sessions_warm_start"] == 1.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        eng.close()
+
+    # tail: heartbeat carries the live block; analyze: children merge
+    hist = LatencyHistogram()
+    hist.observe(0.01)
+    snap = hist.snapshot()
+    (tmp_path / "metrics.jsonl").write_text(json.dumps(
+        {"kind": "serve", "step": 0, "time": time.time(),
+         "serve_requests": 3, "serve_responses": 3}) + "\n")
+    (tmp_path / "heartbeat.json").write_text(json.dumps(
+        {"time": time.time(), "step": 5, "wedged": False,
+         "serve_sessions_warm_steps": 7,
+         "serve_sessions_cold_fallbacks": 2}))
+    out = tail_summary(str(tmp_path))
+    assert out["serve"]["sessions_warm_steps"] == 7
+    assert out["serve"]["sessions_cold_fallbacks"] == 2
+    for i in range(2):
+        d = tmp_path / f"replica-{i}"
+        d.mkdir()
+        (d / "metrics.jsonl").write_text(json.dumps(
+            {"kind": "serve", "step": 0, "time": time.time(),
+             "serve_sessions_warm_steps": 3,
+             "serve_sessions_cold_fallbacks": 1,
+             "serve_sessions_steps": 4,
+             "serve_latency_hist": snap,
+             "serve_session_latency_hist": snap}) + "\n")
+    merged = aggregate_processes(str(tmp_path))["merged"]
+    assert merged["sessions_warm_steps"] == 6
+    assert merged["sessions_cold_fallbacks"] == 2
+    # the per-key histogram merge still lands exactly with the new
+    # counter keys present in the same records
+    assert merged["latency_hist"]["count"] == 2
+    assert merged["session_latency_hist"]["count"] == 2
+
+
+# ------------------------------------------------- real-model quality
+
+
+def _real_model_params(cfg):
+    return _serve_bench()._real_model_params(cfg)
+
+
+def test_warm_real_model_deterministic_bitstable_and_quality(rng):
+    """Real flownet_s: (a) the warm() report covers the cold+warm mode
+    lattice; (b) warm-path flows are bit-stable across repeated
+    dispatches on one engine AND across engines (seeded refinement
+    init); (c) `epe_vs_cold` on a coherent walk is inside the <= 0.5 px
+    quality gate; (d) the warm_start=false walk stays bitwise the
+    pairwise path's (the PR 10 parity pin, under the new code)."""
+    cfg = _cfg(max_batch=2, timeout_ms=2.0)
+    model_params = _real_model_params(cfg)
+    frames = _coherent(np.random.RandomState(3), 5)
+
+    def walk(engine, sid):
+        engine.submit_next(sid, frames[0]).result(120)
+        return [engine.submit_next(sid, f).result(120)
+                for f in frames[1:]]
+
+    with InferenceEngine(cfg, model_params=model_params) as eng:
+        report = eng.warm()
+        modes = {(tuple(b["bucket"]), b["tier"], b["mode"])
+                 for b in report["buckets"]}
+        assert modes == {((32, 64), "f32", "cold"),
+                         ((32, 64), "f32", "warm")}
+        a = walk(eng, "one")
+        b = walk(eng, "two")  # repeated dispatches, same engine
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert np.array_equal(x["flow"], y["flow"]), f"step {i}"
+        assert [r["warm"] for r in a] == [False, True, True, True]
+
+    with InferenceEngine(cfg, model_params=model_params) as eng2:
+        eng2.warm()
+        c = walk(eng2, "three")  # fresh engine: seeded init, same bits
+    for i, (x, y) in enumerate(zip(a, c)):
+        assert np.array_equal(x["flow"], y["flow"]), f"engine step {i}"
+
+    cfg_off = _cfg(max_batch=2, timeout_ms=2.0,
+                   session_kw=dict(warm_start=False))
+    with InferenceEngine(cfg_off, model_params=model_params) as eng3:
+        eng3.warm()
+        cold = walk(eng3, "four")
+        pairwise = [eng3.submit(p, n).result(120)["flow"]
+                    for p, n in zip(frames, frames[1:])]
+    for i, (st, pw) in enumerate(zip(cold, pairwise)):
+        assert np.array_equal(st["flow"], pw), f"pairwise step {i}"
+        assert "warm" not in st
+    # quality gate: warm flows vs the cold walk's on the same frames
+    epes = [float(np.mean(np.sqrt(np.sum((x["flow"] - y["flow"]) ** 2,
+                                         -1))))
+            for x, y in zip(a, cold)]
+    assert max(epes) <= 0.5, epes
+    # the first warm-walk step fell back cold: identical bits
+    assert np.array_equal(a[0]["flow"], cold[0]["flow"])
+
+
+def test_warmup_serve_report_covers_warm_lattice():
+    """`warmup --serve` on a warm-enabled config reports the full
+    bucket x tier x {cold, warm} lattice in engine order (report
+    structure only — the persistence pin is the slow test below)."""
+    from deepof_tpu.train import warmup
+
+    cfg = _cfg(max_batch=2, timeout_ms=2.0)
+    res = warmup.warmup_serve(cfg)
+    assert res["modes"] == ["cold", "warm"]
+    assert [(tuple(b["bucket"]), b["tier"], b["mode"])
+            for b in res["buckets"]] == \
+        [((32, 64), "f32", "cold"), ((32, 64), "f32", "warm")]
+    for b in res["buckets"]:
+        assert b["status"] in ("persisted", "hit", "skipped")
+
+
+# ------------------------------------------------- slow: zero-recompile
+
+
+@pytest.mark.slow
+def test_warmup_serve_then_first_warm_request_compiles_nothing(tmp_path):
+    """The PR 7 zero-recompile acceptance extended to the warm axis:
+    after `warmup --serve` lowers the bucket x tier x {cold, warm}
+    lattice into the persistent cache, a cold engine's first WARM
+    request (prime -> cold-fallback step -> warm step) loads its
+    executables — report-driven, misses <= skipped, exactly the PR 7
+    style (a sub-1 s compile legitimately recompiles next process)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.serve.engine import build_serve_model
+    from deepof_tpu.train import warmup
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        cfg = _cfg(max_batch=2, timeout_ms=40.0, buckets=((64, 64),),
+                   image_size=(64, 64), log_dir=str(tmp_path / "run"))
+        cfg = cfg.replace(model="inception_v3", width_mult=1.0,
+                          train=dataclasses.replace(
+                              cfg.train, compile_cache=True,
+                              compile_cache_dir=str(tmp_path / "xla_cache")))
+
+        r1 = warmup.warmup_serve(cfg)
+        lattice = [((64, 64), "f32", "cold"), ((64, 64), "f32", "warm")]
+        assert [(tuple(b["bucket"]), b["tier"], b["mode"])
+                for b in r1["buckets"]] == lattice
+        assert r1["cache"]["misses"] >= len(lattice)
+        persisted = {(tuple(b["bucket"]), b["tier"], b["mode"])
+                     for b in r1["buckets"] if b["persisted"]}
+        if not persisted:
+            pytest.skip("no lattice entry cleared the 1 s persistence "
+                        "floor on this host — nothing to pin")
+
+        jax.clear_caches()  # simulate a cold serving process
+        model = build_serve_model(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 64, 64, 6)))["params"]
+        rng = np.random.RandomState(0)
+        frames = [rng.randint(1, 255, (60, 60, 3), dtype=np.uint8)
+                  for _ in range(3)]
+        with InferenceEngine(cfg, model_params=(model, params)) as eng:
+            with warmup.cache_delta() as d:
+                eng.submit_next("v", frames[0]).result(600)
+                step1 = eng.submit_next("v", frames[1]).result(600)
+                step2 = eng.submit_next("v", frames[2]).result(600)
+        assert step1["warm"] is False and step2["warm"] is True
+        assert np.isfinite(step2["flow"]).all()
+        delta = d.stats()
+        assert delta["requests"] >= len(lattice)
+        assert delta["hits"] >= len(persisted), \
+            "a persisted lattice entry recompiled — warmup_serve's " \
+            "warm lowering drifted from the engine's"
+        assert delta["misses"] <= len(lattice) - len(persisted), \
+            f"more recompiles ({delta['misses']}) than skipped entries " \
+            f"({len(lattice) - len(persisted)})"
+    finally:
+        warmup.enable_compile_cache(prev)

@@ -35,7 +35,12 @@ per-decode delay (`--decode-ms`), the honest stand-in for real
 jpeg/png decode + preprocess cost on a decode-bound workload. Reports
 `stream_speedup` (the ISSUE 10 acceptance: >= 1.5x on a decode-bound
 walk), the measured decode-count delta, and `flow_bitwise_equal` — the
-streamed flows must be bit-identical to the pairwise walk's.
+streamed flows must be bit-identical to the pairwise walk's. Every
+--stream result ALSO carries the temporal warm-start block
+(`warm_stream_bench`): a REAL flownet_s warm-vs-cold session walk over
+identical seeded coherent frames reporting `warm_speedup` (ISSUE 11
+acceptance: >= 1.3 — the refinement-only executable vs the full cold
+network) and `epe_vs_cold` (quality gate: <= 0.5 px).
 
 --precision [f32,bf16,int8] sweeps the mixed-precision serving tiers
 (serve/quant.py) through ONE real-model engine: per tier it reports
@@ -86,13 +91,20 @@ FLEET_REQUIRED_KEYS = (
     "speedup_vs_single", "failovers", "shed", "max_batch", "exec_ms",
 )
 
-#: keys every --stream result carries (schema smoke test)
+#: keys every --stream result carries (schema smoke test). The warm_*
+#: block is the r11 temporal warm-start axis: a REAL-model warm-vs-cold
+#: walk over identical seeded frames — `warm_speedup` (ISSUE 11
+#: acceptance: >= 1.3 on the cpu proxy) and `epe_vs_cold` (quality
+#: gate: <= 0.5 px) ride every --stream result, pinned here.
 STREAM_REQUIRED_KEYS = (
     "mode", "frames", "flows", "errors", "wall_s", "frames_per_s",
     "pairwise_wall_s", "pairwise_frames_per_s", "stream_speedup",
     "stream_decodes", "pairwise_decodes", "decode_delta", "decode_saved",
     "flow_bitwise_equal", "latency_p50_ms", "latency_p99_ms",
     "max_batch", "timeout_ms", "decode_ms", "exec_ms", "bucket",
+    "warm_speedup", "epe_vs_cold", "warm_frames", "warm_steps",
+    "warm_cold_fallbacks", "warm_width", "warm_bucket",
+    "warm_latency_p50_ms", "warm_cold_latency_p50_ms",
 )
 
 #: keys every --precision result carries at the top level ...
@@ -229,12 +241,18 @@ def stream_bench(frames: int = 32, decode_ms: float = 20.0,
                  exec_ms: float = 2.0, max_batch: int = 4,
                  timeout_ms: float = 2.0, bucket: tuple[int, int] = (32, 64),
                  native_hw: tuple[int, int] = (30, 60),
+                 warm_frames: int = 16, warm_width: float = 0.5,
+                 warm_bucket: tuple[int, int] = (64, 128),
+                 warm_native: tuple[int, int] = (60, 120),
                  log_dir: str | None = None) -> dict:
     """Closed-loop video walk, streamed vs pairwise (see module
     docstring). Both walks drive the identical frame sequence through
     identically configured engines with the same injected decode delay;
     the only variable is the session cache — so `stream_speedup` is the
-    one-decode-per-frame win and nothing else."""
+    one-decode-per-frame win and nothing else. The result additionally
+    carries the `warm_*` block from `warm_stream_bench` (real-model
+    temporal warm-start vs cold full network — its own engines, its own
+    bucket), so one `--stream` run reports both streaming axes."""
     from deepof_tpu.serve.engine import ServeError  # noqa: F401 (doc)
 
     cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
@@ -285,6 +303,16 @@ def stream_bench(frames: int = 32, decode_ms: float = 20.0,
 
     pw_wall, pw_err, pw_flows, pw_decodes, _ = walk_pairwise()
     st_wall, st_err, st_flows, st_decodes, st_stats = walk_stream()
+    if warm_frames > 0:
+        warm = warm_stream_bench(frames=warm_frames, warm_width=warm_width,
+                                 bucket=warm_bucket, native_hw=warm_native,
+                                 log_dir=log_dir)
+    else:
+        # --warm-frames 0: skip the real-model warm walk (keeps the
+        # decode-bound fake-executor bench jax-free); the pinned keys
+        # stay present, as nulls
+        warm = {k: None for k in STREAM_REQUIRED_KEYS
+                if k.startswith(("warm_", "epe_"))}
 
     n_flows = frames - 1
     equal = bool(pw_flows and len(pw_flows) == len(st_flows) and all(
@@ -314,6 +342,126 @@ def stream_bench(frames: int = 32, decode_ms: float = 20.0,
         "max_batch": max_batch, "timeout_ms": timeout_ms,
         "decode_ms": decode_ms, "exec_ms": exec_ms,
         "bucket": list(bucket),
+        **warm,
+    }
+
+
+# ------------------------------------------------------ warm-start
+
+
+def _coherent_walk(rng, native_hw: tuple[int, int], frames: int,
+                   noise: int = 6) -> list:
+    """A temporally coherent seeded frame walk: every frame is the same
+    base image under small independent pixel noise — the synthetic
+    stand-in for consecutive video frames. Temporal coherence is the
+    premise temporal warm-start exploits; iid random frames (the
+    decode-bound walk's workload) would make `epe_vs_cold` measure
+    noise, not the warm path."""
+    base = rng.randint(1, 255, (*native_hw, 3)).astype(np.int16)
+    return [np.clip(base + rng.randint(-noise, noise + 1, base.shape),
+                    0, 255).astype(np.uint8) for _ in range(frames)]
+
+
+def warm_stream_bench(frames: int = 16, warm_width: float = 0.5,
+                      max_batch: int = 1, model_width: float = 0.5,
+                      bucket: tuple[int, int] = (64, 128),
+                      native_hw: tuple[int, int] = (60, 120),
+                      log_dir: str | None = None) -> dict:
+    """Temporal warm-start vs cold, REAL model (flownet_s, random init
+    or --log-dir's checkpoint): the identical seeded coherent frame walk
+    runs twice through session engines differing ONLY in
+    `serve.session.warm_start` — cold dispatches the full network every
+    step, warm dispatches the refinement-only executable once a prior
+    flow exists. The walks are INTERLEAVED step by step (alternating
+    order) so host-load noise hits both paths equally, and
+    `warm_speedup` is the ratio of median per-step latencies — the
+    executables' story, not the scheduler's. `epe_vs_cold` is the mean
+    endpoint error of the warm walk's flows against the cold walk's on
+    the same steps — the quality gate that makes the cheaper path
+    provably not a quality regression.
+
+    model_width: the COLD network's width multiplier — 0.5 here, not
+    the suite's usual 0.25 thin variant, because `scaled_width`'s
+    8-channel floor clips the refinement stage's width cut at
+    0.25 x warm_width and would understate a ratio that is
+    architecture-real at production widths (the floor artifact)."""
+    frames = max(int(frames), 3)
+    cfg = _bench_cfg(bucket, max_batch, 0.0, log_dir)
+    cfg = cfg.replace(width_mult=model_width)
+
+    def _session_cfg(warm: bool):
+        return cfg.replace(serve=dataclasses.replace(
+            cfg.serve, session=dataclasses.replace(
+                cfg.serve.session, warm_start=warm,
+                warm_width=warm_width)))
+
+    model_params = (_real_model_params(_session_cfg(True))
+                    if not log_dir else None)
+    rng = np.random.RandomState(0)
+    imgs = _coherent_walk(rng, native_hw, frames)
+
+    # INTERLEAVED measurement: both engines live at once, and every
+    # frame steps the cold walk and the warm walk back to back (order
+    # alternating per frame). On a small contended host, sequential
+    # walks seconds apart see different machines — interleaving makes
+    # host-load noise hit both paths equally, so the median-latency
+    # ratio measures the executables, not the scheduler.
+    def step(engine, frame, flows, lats, errs):
+        try:
+            r = engine.submit_next("warm-bench", frame).result(120.0)
+            flows.append(r["flow"])
+            lats.append(r["latency_s"])
+        except Exception:  # noqa: BLE001 - counted
+            errs.append(1)
+            flows.append(None)
+
+    cold_flows, cold_lats, cold_errs = [], [], []
+    warm_flows, warm_lats, warm_errs = [], [], []
+    with InferenceEngine(_session_cfg(False),
+                         model_params=model_params) as cold_eng, \
+            InferenceEngine(_session_cfg(True),
+                            model_params=model_params) as warm_eng:
+        cold_eng.warm()
+        warm_eng.warm()  # both lattices AOT-compiled before timing
+        assert cold_eng.submit_next("warm-bench",
+                                    imgs[0]).result(120.0).get("primed")
+        assert warm_eng.submit_next("warm-bench",
+                                    imgs[0]).result(120.0).get("primed")
+        t0 = time.perf_counter()
+        for i, frame in enumerate(imgs[1:]):
+            order = ((cold_eng, cold_flows, cold_lats, cold_errs),
+                     (warm_eng, warm_flows, warm_lats, warm_errs))
+            for eng, flows, lats, errs in (order if i % 2 == 0
+                                           else order[::-1]):
+                step(eng, frame, flows, lats, errs)
+        wall = time.perf_counter() - t0
+        warm_stats = warm_eng.stats()
+    cold_err, warm_err = len(cold_errs), len(warm_errs)
+
+    deltas = [float(np.mean(np.sqrt(np.sum((a - b) ** 2, -1))))
+              for a, b in zip(warm_flows, cold_flows)
+              if a is not None and b is not None]
+    med_warm = float(np.median(warm_lats)) if warm_lats else None
+    med_cold = float(np.median(cold_lats)) if cold_lats else None
+    return {
+        "warm_frames": frames,
+        "warm_errors": warm_err,
+        "warm_cold_errors": cold_err,  # the cold REFERENCE walk's errors
+        # one shared wall: the walks interleave in a single window
+        "warm_wall_s": round(wall, 4),
+        "warm_latency_p50_ms": (round(1e3 * med_warm, 3)
+                                if med_warm else None),
+        "warm_cold_latency_p50_ms": (round(1e3 * med_cold, 3)
+                                     if med_cold else None),
+        "warm_speedup": (round(med_cold / med_warm, 2)
+                         if med_warm and med_cold else None),
+        "epe_vs_cold": (round(float(np.mean(deltas)), 6)
+                        if deltas else None),
+        "warm_steps": warm_stats["serve_sessions_warm_steps"],
+        "warm_cold_fallbacks": warm_stats["serve_sessions_cold_fallbacks"],
+        "warm_width": warm_width,
+        "warm_model_width": model_width,
+        "warm_bucket": list(bucket),
     }
 
 
@@ -606,6 +754,14 @@ def main(argv=None) -> int:
     ap.add_argument("--decode-ms", type=float, default=20.0,
                     help="stream mode: injected per-decode delay (the "
                          "decode-bound workload stand-in)")
+    ap.add_argument("--warm-frames", type=int, default=16,
+                    help="stream mode: frames in the real-model temporal "
+                         "warm-start walk (warm_speedup / epe_vs_cold); "
+                         "0 skips the warm block entirely (keeps --stream "
+                         "jax-free, warm keys reported as null)")
+    ap.add_argument("--warm-width", type=float, default=0.5,
+                    help="stream mode: serve.session.warm_width for the "
+                         "warm refinement stage")
     ap.add_argument("--precision", nargs="?", const="f32,bf16,int8",
                     default=None, metavar="TIERS",
                     help="sweep mixed-precision serving tiers (comma "
@@ -631,6 +787,8 @@ def main(argv=None) -> int:
                            exec_ms=exec_ms, max_batch=args.max_batch,
                            timeout_ms=timeout_ms,
                            bucket=hw(args.bucket), native_hw=hw(args.native),
+                           warm_frames=args.warm_frames,
+                           warm_width=args.warm_width,
                            log_dir=args.log_dir)
     elif args.precision is not None:
         res = precision_bench(
