@@ -21,6 +21,8 @@ import statistics
 import time
 from collections import defaultdict
 
+from .obs.registry import merge_stats_blocks, resilience_keys
+
 
 def _finite(records: list[dict], key: str) -> list[dict]:
     return [r for r in records
@@ -89,13 +91,10 @@ def _counter_summary(rec: dict) -> dict | None:
 
 #: Resilience-layer counters (cumulative, in train records AND the
 #: heartbeat): recovery activity an operator should see at a glance.
-_RESILIENCE_KEYS = (
-    "skipped_updates", "rollbacks",
-    "data_sample_retries", "data_quarantined", "data_substituted",
-    "data_retries", "pipeline_fetch_retries",
-    "ckpt_save_failures", "ckpt_restore_failures",
-    "ckpt_restore_fallbacks", "ckpt_verify_failures",
-)
+#: Driven from the observability schema (obs/registry.py — the single
+#: owner of which keys exist and how they surface), not a hand-kept
+#: list: registering a counter with resilience=True adds it here.
+_RESILIENCE_KEYS = resilience_keys()
 
 
 def _resilience_counters(rec: dict) -> dict:
@@ -290,29 +289,17 @@ def aggregate_processes(log_dir: str, now: float | None = None) -> dict | None:
         return None
     now = time.time() if now is None else now
     children = {name: _process_summary(d, now) for name, d in dirs.items()}
-    merged: dict = {}
-    # histograms merge PER KEY (request latency and per-session-frame
-    # latency are separate stories); counters sum per key
-    hists: dict[str, list] = {}
-    for child in children.values():
-        serve = child.get("serve") or {}
-        for k in ("requests", "responses", "errors", "batches",
-                  "sessions_active", "sessions_created", "sessions_frames",
-                  "sessions_steps", "sessions_decode_saved",
-                  "sessions_warm_steps", "sessions_cold_fallbacks"):
-            if isinstance(serve.get(k), (int, float)):
-                merged[k] = merged.get(k, 0) + serve[k]
-        for k, v in serve.items():
-            if k.endswith("latency_hist") and v:
-                hists.setdefault(k, []).append(v)
-    if hists:
-        from .obs.export import merge_hists  # stdlib-only import chain
-
-        for k, hs in hists.items():
-            try:
-                merged[k] = merge_hists(hs)
-            except ValueError:
-                pass  # foreign/old-format snapshot: skip, never crash tail
+    # registry-driven merge (obs/registry.py): every serve-owned counter
+    # combines by its declared kind — sums add, high-water marks max,
+    # per-tier maps merge key-wise, histograms merge EXACTLY per key
+    # (request latency and per-session-frame latency are separate
+    # stories), gauges/bools/derived values are dropped. A counter
+    # registered tomorrow joins this block with no edit here — the
+    # hand-kept sum list this replaces missed one in four of the last
+    # six PRs.
+    merged = merge_stats_blocks(
+        [child.get("serve") or {} for child in children.values()],
+        prefix="serve_")  # child blocks store serve_* keys stripped
     out = {"processes": children}
     if merged:
         out["merged"] = merged

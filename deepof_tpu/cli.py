@@ -270,6 +270,25 @@ def main(argv=None) -> int:
     p_vck.add_argument("dir",
                        help="a run's --log-dir or its ckpt/ subdirectory")
 
+    p_lint = sub.add_parser(
+        "lint", help="graftlint: project-invariant static analysis "
+                     "(DESIGN.md \"Static analysis\"): counters "
+                     "registered in obs/registry.py, config attribute "
+                     "typos, determinism (unseeded randomness in the "
+                     "data/model path), jit-purity (side effects in "
+                     "traced code), and cross-thread lock discipline. "
+                     "jax-free; exit 0 clean, 2 on findings, 1 on "
+                     "usage error")
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "deepof_tpu package + tools/)")
+    p_lint.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings (CI mode)")
+    p_lint.add_argument("--rule", action="append", default=None,
+                        metavar="NAME",
+                        help="run only this rule (repeatable); default: "
+                             "all rules")
+
     p_tail = sub.add_parser(
         "tail", help="one-glance health of a live or finished run: step, "
                      "loss, recent vs overall throughput, phase shares, "
@@ -293,6 +312,43 @@ def main(argv=None) -> int:
     p_tail.add_argument("--interval", type=float, default=10.0)
 
     args = parser.parse_args(argv)
+
+    if args.cmd == "lint":
+        # jax-free by design (lint/ imports stdlib + core.config +
+        # obs.registry only): the CI gate must run on hosts with no
+        # accelerator stack at all
+        import time as _time
+
+        from .lint import RULES, lint_paths
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        paths = args.paths or [
+            p for p in (os.path.join(repo_root, "deepof_tpu"),
+                        os.path.join(repo_root, "tools"))
+            if os.path.isdir(p)]
+        selected = sorted(set(args.rule)) if args.rule else sorted(RULES)
+        t0 = _time.perf_counter()
+        try:
+            findings = lint_paths(paths, rules=selected)
+        except (ValueError, FileNotFoundError) as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 1  # usage error: distinct from "findings" (2)
+        elapsed = round(_time.perf_counter() - t0, 3)
+        live = [f for f in findings if not f.waived]
+        waived = [f for f in findings if f.waived]
+        if args.as_json:
+            print(json.dumps({
+                "findings": [f.as_dict() for f in live],
+                "waived": [f.as_dict() for f in waived],
+                "rules": selected,
+                "elapsed_s": elapsed}))
+        else:
+            for f in findings:
+                print(f.format())
+            print(f"lint: {len(live)} finding(s), {len(waived)} waived, "
+                  f"{len(selected)} rule(s) in {elapsed}s")
+        return 2 if live else 0
 
     if args.cmd == "verify-ckpt":
         # jax-free by design (resilience/verify.py is stdlib-only): the

@@ -1,0 +1,17 @@
+"""graftlint — project-invariant static analysis (`deepof_tpu lint`).
+
+An AST-based, jax-free linter for the defect classes PRs 1-11 kept
+hand-fixing in review: counters missing from merge lists, config typos
+only caught at runtime, unseeded randomness in the determinism-pinned
+data path, side effects inside traced code, and cross-thread writes
+outside the class lock. DESIGN.md "Static analysis" documents each
+rule; `obs/registry.py` is the schema the counter rule checks against.
+
+Import discipline: stdlib + `core.config` + `obs.registry` only — the
+linter must run on a machine (or in a CI stage) with no jax installed,
+and must never initialize an accelerator backend.
+"""
+
+from .core import (Finding, RULES, lint_paths, lint_source,  # noqa: F401
+                   rule)
+from . import rules as _rules  # noqa: F401 - registers the rule set

@@ -1,0 +1,191 @@
+"""Lint framework: findings, the rule registry, inline waivers, and the
+file/tree runners. Rules live in `lint/rules.py`; this module is
+mechanism only.
+
+A rule is a function ``(ctx: FileContext) -> Iterable[Finding]``
+registered with the ``@rule(name)`` decorator. Findings carry (rule,
+path, line, col, message); the runner applies inline waivers before
+returning them.
+
+Waivers: a finding is waived by a comment on ITS line, or on the line
+directly above (for lines too long to carry a trailing comment)::
+
+    self._devmem = snap  # lint: lock-discipline-ok(atomic rebind)
+
+    # lint: determinism-ok(wall-clock only feeds the report header)
+    stamp = time.time()
+
+The syntax is ``# lint: <rule>-ok(<reason>)``; the reason is REQUIRED —
+a bare ``<rule>-ok`` does not waive (an unexplained suppression is the
+reviewer-vigilance regression this linter exists to end). Multiple
+waivers may share one comment, comma-separated. Waived findings are
+still reported (marked ``waived``) so ``--json`` consumers can audit
+them, but they do not affect the exit code.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+#: rule name -> (fn, one-line doc). Populated by @rule at import of
+#: lint/rules.py.
+RULES: dict[str, tuple[Callable, str]] = {}
+
+_WAIVER_RE = re.compile(r"#\s*lint:\s*(.+)$")
+#: reason may contain one level of nested parens ("... (see DESIGN.md)")
+_WAIVER_ITEM_RE = re.compile(
+    r"([a-z][a-z0-9-]*)-ok\(((?:[^()]|\([^()]*\))*)\)")
+
+
+def rule(name: str, doc: str = ""):
+    """Register a rule function under `name` (kebab-case)."""
+    def deco(fn: Callable) -> Callable:
+        if name in RULES:
+            raise ValueError(f"lint rule {name!r} registered twice")
+        RULES[name] = (fn, doc or (fn.__doc__ or "").strip().split("\n")[0])
+        return fn
+    return deco
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waive_reason: str | None = None
+
+    def format(self) -> str:
+        mark = " [waived]" if self.waived else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}: {self.message}{mark}")
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "waived": self.waived, "waive_reason": self.waive_reason}
+
+
+@dataclass
+class FileContext:
+    """Everything a rule gets to look at: one parsed file."""
+
+    path: str
+    source: str
+    tree: ast.AST
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+def _waivers(source: str,
+             lines: list[str]) -> dict[int, dict[str, str]]:
+    """{1-based line -> {rule -> reason}} of lines each waiver covers
+    (its own line, plus the next line when the comment stands alone).
+    Only REAL comment tokens count — a string literal that happens to
+    contain the waiver syntax (docs, test fixtures) must never
+    suppress a finding."""
+    out: dict[int, dict[str, str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.start[1], t.string)
+                    for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # unparseable files already yield a `parse` finding
+    for lineno, col, text in comments:
+        m = _WAIVER_RE.search(text)
+        if m is None:
+            continue
+        items = {r: reason.strip()
+                 for r, reason in _WAIVER_ITEM_RE.findall(m.group(1))
+                 if reason.strip()}  # reason REQUIRED
+        if not items:
+            continue
+        covered = [lineno]
+        line_text = lines[lineno - 1] if lineno <= len(lines) else ""
+        if not line_text[:col].strip():
+            covered.append(lineno + 1)  # standalone: waives next line
+        for ln in covered:
+            out.setdefault(ln, {}).update(items)
+    return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Iterable[str] | None = None) -> list[Finding]:
+    """Lint one source string. Unknown rule names raise ValueError (the
+    CLI turns that into its usage-error exit code). A syntax error in
+    the target file is itself a finding (rule ``parse``), never a
+    linter crash."""
+    selected = _validate_rules(rules)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("parse", path, e.lineno or 1, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(path=path, source=source, tree=tree)
+    waivers = _waivers(source, ctx.lines)
+    findings: list[Finding] = []
+    for name in selected:
+        fn, _ = RULES[name]
+        for f in fn(ctx):
+            line_waivers = waivers.get(f.line, {})
+            if f.rule in line_waivers:
+                f.waived = True
+                f.waive_reason = line_waivers[f.rule]
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    """Expand files/directories into a sorted list of .py files
+    (skipping __pycache__ and hidden dirs). Missing paths raise
+    FileNotFoundError — a typo'd path must not lint 'clean'."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d != "__pycache__" and not d.startswith(".")]
+                out.extend(os.path.join(root, f) for f in files
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(f"lint: no such file or directory: {p}")
+    return sorted(set(out))
+
+
+def _validate_rules(rules: Iterable[str] | None) -> list[str]:
+    """Selected rule names, validated. Unknown names raise ValueError —
+    the CLI's usage-error exit — and are checked UP FRONT, not per
+    file: a typo'd --rule over a path set that happens to hold no .py
+    files must still fail loudly, never report 'clean'."""
+    selected = list(rules) if rules is not None else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown lint rule(s) {unknown} — available: {sorted(RULES)}")
+    return selected
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Iterable[str] | None = None) -> list[Finding]:
+    """Lint every .py file under `paths`."""
+    rules = _validate_rules(rules)
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, path=path, rules=rules))
+    return findings

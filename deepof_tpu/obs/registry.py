@@ -1,0 +1,311 @@
+"""The observability schema: ONE declaration per counter key.
+
+Every stats surface in this repo (the engine's ``serve_*`` block, the
+router/supervisor ``fleet_*`` block, the coordinator's ``elastic_*``
+block, the train loop's ``data_*``/``fault_*``/resilience merge) used to
+be an ad-hoc flat dict whose MERGE behavior lived in per-consumer lists:
+`analyze.aggregate_processes` enumerated which serve counters sum,
+`Router.scrape_replicas` carried skip/max frozensets plus suffix
+heuristics, `analyze._RESILIENCE_KEYS` enumerated the recovery counters.
+PRs 4, 6, 7, 9, 10 and 11 each hand-patched one of those lists after a
+new counter silently missed it — the exact mechanical defect class this
+module retires.
+
+This registry is the single schema owner:
+
+  - every key declares its **merge kind** (how N processes' values
+    combine into one fleet-wide value) and its **owner** (which
+    subsystem writes it);
+  - the merge paths (`analyze.aggregate_processes`,
+    `Router.scrape_replicas` -> Prometheus `/metrics`, the
+    resilience-counter surface) are DRIVEN from it — a registered
+    counter joins every aggregate automatically;
+  - `deepof_tpu lint`'s ``counter-registry`` rule cross-checks that
+    every ``serve_*``/``fleet_*``/``elastic_*``/``data_*``/``fault_*``
+    key written into a stats dict anywhere in the package is declared
+    here — an unregistered counter is a CI failure, not a silent gap
+    in the fleet scrape.
+
+Merge kinds:
+
+  sum      additive event counter — fleet value = sum of processes'
+  max      high-water mark — fleet value = max of processes'
+  gauge    per-process configuration or instantaneous reading (replica
+           count, queue depth ceiling, generation) — never merged; a
+           2-replica fleet does not have max_batch 16
+  bool     flag — never merged (summing booleans exports nonsense)
+  hist     fixed-bucket LatencyHistogram snapshot (obs/export.py) —
+           merged EXACTLY bucket-wise via merge_hists, per key
+  map      dict of numeric sub-counters (per-tier, per-replica) —
+           merged key-wise by sum
+  state    dict of string states (replica/host state machines) — never
+           merged (states are per-process identity)
+  derived  computed from other keys (percentiles, rates, means, SLO
+           blocks) — never merged; the honest fleet figure is
+           re-derived from the merged histogram/counters
+
+Stdlib-only at import (the obs/__init__ discipline): analyze/tail, the
+jax-free supervisors, and the linter all import this module.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: The key prefixes the `counter-registry` lint rule polices: a literal
+#: dict key with one of these prefixes written anywhere in the package
+#: must resolve in this registry.
+LINTED_PREFIXES: tuple[str, ...] = (
+    "serve_", "fleet_", "elastic_", "data_", "fault_")
+
+MERGE_KINDS: frozenset[str] = frozenset((
+    "sum", "max", "gauge", "bool", "hist", "map", "state", "derived"))
+
+
+@dataclass(frozen=True)
+class Key:
+    """One observability key's schema entry.
+
+    name: the full key as written into stats dicts ("serve_requests");
+        for a prefix family, the shared prefix ("fault_").
+    kind: merge kind (see module docstring).
+    owner: the subsystem that writes it — engine | session | server |
+        router | fleet | elastic | data | resilience | ckpt | faults |
+        train.
+    prefix: True = family entry: every key starting with `name`
+        resolves here (dynamically named counters — per-site fault
+        counts). Exact entries always win over families.
+    resilience: True = part of the resilience-counter surface analyze/
+        tail show as the `resilience` block (nonzero values only).
+    """
+
+    name: str
+    kind: str
+    owner: str
+    prefix: bool = False
+    resilience: bool = False
+
+
+def _keys(owner: str, kind: str, *names: str, **kw) -> list[Key]:
+    return [Key(n, kind, owner, **kw) for n in names]
+
+
+_ENTRIES: list[Key] = [
+    # ------------------------------------------ serve_* (engine core)
+    *_keys("engine", "sum",
+           "serve_requests", "serve_responses", "serve_errors",
+           "serve_server_errors", "serve_batches",
+           "serve_dispatch_failures", "serve_bucket_splits",
+           "serve_tier_splits", "serve_warm_splits",
+           "serve_timeout_flushes",
+           # instantaneous per-replica depth, but the sum IS the honest
+           # fleet figure: total requests queued across the pool
+           "serve_queue_depth"),
+    Key("serve_max_queue_depth", "max", "engine"),
+    *_keys("engine", "gauge",
+           "serve_max_batch", "serve_buckets", "serve_tiers",
+           "serve_last_occupancy"),
+    *_keys("engine", "map",
+           "serve_requests_by_tier", "serve_responses_by_tier"),
+    *_keys("engine", "derived",
+           "serve_occupancy_mean", "serve_latency_p50_ms",
+           "serve_latency_p99_ms", "serve_requests_per_s", "serve_slo"),
+    Key("serve_latency_hist", "hist", "engine"),
+    # ------------------------------- serve_sessions_* (session store)
+    *_keys("session", "sum",
+           "serve_sessions_active", "serve_sessions_created",
+           "serve_sessions_resumed", "serve_sessions_expired",
+           "serve_sessions_evicted", "serve_sessions_deleted",
+           "serve_sessions_rebucketed", "serve_sessions_frames",
+           "serve_sessions_steps", "serve_sessions_decode_saved",
+           "serve_sessions_warm_steps", "serve_sessions_cold_fallbacks"),
+    Key("serve_sessions_warm_start", "bool", "session"),
+    Key("serve_session_latency_hist", "hist", "session"),
+    *_keys("session", "derived",
+           "serve_session_latency_p50_ms", "serve_session_latency_p99_ms"),
+    # ------------------------------ serve_* written by the fleet scrape
+    *_keys("router", "sum",
+           "serve_replicas_scraped", "serve_replicas_scrape_failed"),
+    # --------------------------------------- fleet_* (router half)
+    *_keys("router", "sum",
+           "fleet_requests", "fleet_responses", "fleet_errors",
+           "fleet_server_errors", "fleet_failovers", "fleet_retries",
+           "fleet_shed", "fleet_unavailable",
+           "fleet_session_primes", "fleet_session_steps",
+           "fleet_session_lost", "fleet_session_evicted",
+           "fleet_session_expired"),
+    *_keys("router", "gauge", "fleet_in_flight", "fleet_sessions_sticky"),
+    Key("fleet_routed", "map", "router"),
+    Key("fleet_draining", "bool", "router"),
+    Key("fleet_latency_hist", "hist", "router"),
+    Key("fleet_slo", "derived", "router"),
+    # ----------------------------------- fleet_* (supervisor half)
+    *_keys("fleet", "gauge", "fleet_replicas", "fleet_ready"),
+    Key("fleet_states", "state", "fleet"),
+    *_keys("fleet", "sum",
+           "fleet_evictions", "fleet_crashes", "fleet_clean_exits",
+           "fleet_wedge_evictions", "fleet_stale_evictions",
+           "fleet_spawn_failures", "fleet_respawns", "fleet_broken",
+           "fleet_kill_escalations"),
+    # ------------------------------------- elastic_* (coordinator)
+    *_keys("elastic", "gauge",
+           "elastic_hosts", "elastic_live", "elastic_done",
+           "elastic_generation", "elastic_resumed_step",
+           "elastic_target_step", "elastic_last_reform_s"),
+    *_keys("elastic", "sum",
+           "elastic_reforms", "elastic_lost_hosts", "elastic_preemptions",
+           "elastic_steps_lost", "elastic_spawns", "elastic_respawns",
+           "elastic_kill_escalations"),
+    Key("elastic_max_step", "max", "elastic"),
+    Key("elastic_states", "state", "elastic"),
+    # --------------------- data_* (pipeline / prefetch / healer merge,
+    # train/loop.py resilience_stats prefixes their stats() dicts)
+    Key("data_num_workers", "gauge", "data"),
+    *_keys("data", "sum",
+           "data_batches", "data_assemble_s", "data_waits", "data_wait_s"),
+    *_keys("data", "derived", "data_assemble_s_mean", "data_worker_util"),
+    *_keys("data", "gauge", "data_queue_depth", "data_staged_depth"),
+    *_keys("data", "max", "data_max_queue_depth", "data_max_staged_depth"),
+    # decoded-image LRU counters (data/datasets.py _DecodedCache, merged
+    # into train records under the same data_ prefix)
+    Key("data_decode_cache_", "sum", "data", prefix=True),
+    # ------------------------------ fault_* (resilience/faults.py):
+    # per-site injection counts are dynamically named — one family
+    Key("fault_", "sum", "faults", prefix=True, resilience=True),
+    # ------------------- the resilience surface (train records +
+    # heartbeat; analyze/tail's `resilience` block). Declaration order
+    # here IS the block's key order (resilience_keys() preserves it —
+    # pinned byte-identical to the pre-registry _RESILIENCE_KEYS tuple),
+    # so new entries append at the end.
+    *_keys("train", "sum", "skipped_updates", "rollbacks",
+           resilience=True),
+    *_keys("data", "sum",
+           "data_sample_retries", "data_quarantined", "data_substituted",
+           "data_retries", resilience=True),
+    Key("pipeline_fetch_retries", "sum", "data", resilience=True),
+    *_keys("ckpt", "sum",
+           "ckpt_save_failures", "ckpt_restore_failures",
+           "ckpt_restore_fallbacks", "ckpt_verify_failures",
+           resilience=True),
+    # non-resilience ckpt counter (rides the same ckpt_ stats prefix)
+    Key("ckpt_saves", "sum", "ckpt"),
+]
+
+#: name -> Key for exact entries (validated no-duplicate below).
+REGISTRY: dict[str, Key] = {}
+#: prefix families, longest prefix first (most specific wins).
+FAMILIES: list[Key] = []
+
+for _k in _ENTRIES:
+    if _k.kind not in MERGE_KINDS:
+        raise ValueError(f"registry: bad kind {_k.kind!r} for {_k.name!r}")
+    if _k.prefix:
+        FAMILIES.append(_k)
+    else:
+        if _k.name in REGISTRY:
+            raise ValueError(f"registry: duplicate key {_k.name!r}")
+        REGISTRY[_k.name] = _k
+FAMILIES.sort(key=lambda k: -len(k.name))
+
+
+def lookup(name: str) -> Key | None:
+    """The schema entry for a stats key: exact match first, then the
+    longest matching prefix family. None = unregistered."""
+    hit = REGISTRY.get(name)
+    if hit is not None:
+        return hit
+    for fam in FAMILIES:
+        if name.startswith(fam.name):
+            return fam
+    return None
+
+
+def merge_kind(name: str) -> str | None:
+    """The key's merge kind, or None when unregistered."""
+    hit = lookup(name)
+    return hit.kind if hit is not None else None
+
+
+def resilience_keys() -> tuple[str, ...]:
+    """The exact-named resilience-surface counters — drives
+    `analyze._RESILIENCE_KEYS` (prefix families like fault_* are
+    surfaced by their prefix in analyze, not enumerated here)."""
+    return tuple(k.name for k in _ENTRIES
+                 if k.resilience and not k.prefix)
+
+
+def keys_for_owner(owner: str) -> tuple[str, ...]:
+    return tuple(k.name for k in _ENTRIES if k.owner == owner)
+
+
+# ------------------------------------------------- generic dict merging
+
+
+def merge_stats_blocks(blocks: list[dict], prefix: str = "") -> dict:
+    """Registry-driven merge of N processes' flat stats dicts into one
+    fleet-wide dict — THE aggregation primitive behind
+    `Router.scrape_replicas` and `analyze.aggregate_processes`.
+
+    prefix: keys in `blocks` may be stored stripped of their registry
+    prefix (analyze's serve block drops "serve_"); lookups prepend it.
+
+    Per key, by registry kind: sum adds, max takes the maximum, map
+    merges key-wise by sum, hist merges exactly (foreign-bucket
+    snapshots are skipped, never a crash), gauge/bool/state/derived are
+    dropped (their fleet-wide value is meaningless or re-derived).
+    UNREGISTERED keys fall back to the historical suffix heuristic —
+    numeric values sum unless they look derived (_p50_ms/_p99_ms/
+    _per_s/_mean) — so scraping a newer replica that exports a key this
+    process's registry predates degrades to the old behavior instead of
+    dropping data silently.
+    """
+    from .export import is_hist_snapshot, merge_hists
+
+    sums: dict = {}
+    maxima: dict = {}
+    maps: dict[str, dict] = {}
+    hists: dict[str, list] = {}
+    for block in blocks:
+        if not block:
+            continue
+        for k, v in block.items():
+            kind = merge_kind(prefix + k)
+            if kind is None:  # unregistered: the historical heuristic
+                if is_hist_snapshot(v):
+                    kind = "hist"
+                elif isinstance(v, bool):
+                    kind = "bool"
+                elif isinstance(v, (int, float)):
+                    kind = ("derived" if k.endswith(
+                        ("_p50_ms", "_p99_ms", "_per_s", "_mean"))
+                        else "sum")
+                elif isinstance(v, dict):
+                    kind = "map"
+                else:
+                    continue
+            if kind == "sum" and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                sums[k] = sums.get(k, 0) + v
+            elif kind == "max" and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                maxima[k] = max(maxima.get(k, 0), v)
+            elif kind == "map" and isinstance(v, dict):
+                tgt = maps.setdefault(k, {})
+                for sub, n in v.items():
+                    if isinstance(n, (int, float)) \
+                            and not isinstance(n, bool):
+                        tgt[sub] = tgt.get(sub, 0) + n
+            elif kind == "hist" and is_hist_snapshot(v):
+                hists.setdefault(k, []).append(v)
+            # gauge / bool / state / derived: deliberately dropped
+    out = {**sums, **maxima}
+    # a map with no numeric sub-values merged (e.g. an unregistered
+    # state-style dict from a newer replica) is dropped, not exported
+    # as a meaningless empty {} — matching the retired implementation
+    out.update({k: dict(v) for k, v in maps.items() if v})
+    for k, hs in hists.items():
+        try:
+            out[k] = merge_hists(hs)
+        except ValueError:
+            pass  # foreign/old-format snapshot: skip, never crash
+    return out

@@ -70,8 +70,9 @@ from typing import Callable
 
 from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
-from ..obs.export import (LatencyHistogram, is_hist_snapshot, merge_hists,
-                          render_prometheus, slo_state, validate_slo)
+from ..obs.export import (LatencyHistogram, render_prometheus, slo_state,
+                          validate_slo)
+from ..obs.registry import merge_stats_blocks
 from .buckets import pick_bucket, resolve_buckets
 from .quant import resolve_precisions
 
@@ -605,24 +606,19 @@ class Router:
         return out
 
     # ---------------------------------------------------------- /metrics
-    #: serve_* keys that are per-replica configuration or instantaneous
-    #: occupancy — summing them across the fleet would export nonsense
-    #: (a 2-replica fleet does not have max_batch 16)
-    _SCRAPE_SKIP = frozenset((
-        "serve_max_batch", "serve_buckets", "serve_tiers",
-        "serve_last_occupancy"))
-    #: per-replica high-water marks: the honest fleet value is the max
-    _SCRAPE_MAX = frozenset(("serve_max_queue_depth",))
-
     def scrape_replicas(self, timeout_s: float = 2.0) -> dict:
         """Fleet-aggregated serve_* block: GET /healthz on every ready
         replica (concurrently — one wedged-but-still-ready replica must
         cost at most ONE timeout, not one per scrape position) and
-        merge — additive counters sum, per-tier maps sum by key,
-        high-water marks take the max, per-replica config keys are
-        dropped, and the latency histograms merge EXACTLY (fixed shared
-        buckets, obs/export.py) so the fleet-wide bucket counts equal
-        the sum of the replicas' at scrape time. Replicas that fail the
+        merge by each key's DECLARED kind (obs/registry.py, the schema
+        owner): additive counters sum, per-tier maps sum by key,
+        high-water marks take the max, per-replica gauges/bools/derived
+        values are dropped, and the latency histograms merge EXACTLY
+        (fixed shared buckets, obs/export.py) so the fleet-wide bucket
+        counts equal the sum of the replicas' at scrape time. A counter
+        registered tomorrow joins this scrape with no edit here — the
+        skip/max frozensets + suffix heuristics this replaces needed a
+        hand patch in four of the last six PRs. Replicas that fail the
         scrape are skipped and counted."""
         def fetch(replica):
             conn = http.client.HTTPConnection(
@@ -646,45 +642,12 @@ class Router:
                         results.append(fut.result())
                     except Exception:  # noqa: BLE001 - sick replica: skip
                         results.append(None)
-        totals: dict = {}
-        maxima: dict = {}
-        by_tier: dict[str, dict] = defaultdict(lambda: defaultdict(int))
-        # histograms merge PER KEY: a replica now exports two (request
-        # latency + per-session-frame latency) and folding them together
-        # would corrupt both stories
-        hists: dict[str, list[dict]] = defaultdict(list)
-        scraped = failed = 0
-        for stats in results:
-            if stats is None:
-                failed += 1
-                continue
-            scraped += 1
-            for k, v in stats.items():
-                if not k.startswith("serve_") or k in self._SCRAPE_SKIP:
-                    continue
-                if is_hist_snapshot(v):
-                    hists[k].append(v)
-                elif k in ("serve_requests_by_tier",
-                           "serve_responses_by_tier") \
-                        and isinstance(v, dict):
-                    for tier, n in v.items():
-                        if isinstance(n, (int, float)):
-                            by_tier[k][tier] += n
-                elif isinstance(v, bool):
-                    continue
-                elif k in self._SCRAPE_MAX and isinstance(v, (int, float)):
-                    maxima[k] = max(maxima.get(k, 0), v)
-                elif isinstance(v, (int, float)) and not k.endswith(
-                        ("_p50_ms", "_p99_ms", "_per_s", "_mean")):
-                    # sums only: percentiles/rates/means do not add —
-                    # the merged histogram is the honest fleet latency
-                    totals[k] = totals.get(k, 0) + v
-        out = {**totals, **maxima}
-        out.update({k: dict(v) for k, v in by_tier.items()})
-        for k, hs in hists.items():
-            out[k] = merge_hists(hs)
-        out["serve_replicas_scraped"] = scraped
-        out["serve_replicas_scrape_failed"] = failed
+        blocks = [{k: v for k, v in stats.items()
+                   if k.startswith("serve_")}
+                  for stats in results if stats is not None]
+        out = merge_stats_blocks(blocks)
+        out["serve_replicas_scraped"] = len(blocks)
+        out["serve_replicas_scrape_failed"] = len(results) - len(blocks)
         return out
 
     def metrics_text(self) -> str:

@@ -1,0 +1,176 @@
+"""Deterministic observability fixture run dir (tests/test_lint.py).
+
+Builds `tests/fixtures/obs_run/` — a frozen 2-replica fleet drill's
+artifacts (supervisor metrics.jsonl + heartbeat.json, replica-N
+subdirs, and the two replica /healthz payloads the scrape test serves
+from stubs) — with every timestamp and counter fixed, so the
+analyze/tail/scrape merge output over it is byte-for-byte reproducible.
+The goldens under `tests/fixtures/goldens/` pin that output; this
+script regenerates the fixture if the schema ever needs to grow (run
+it from the repo root, then re-record the goldens per the test
+docstring).
+
+Every serve_* block carries the FULL engine stats() key schema
+(histograms, per-tier maps, the warm_start bool, derived percentiles,
+an SLO block) so the merge paths are exercised over every merge kind
+the registry declares.
+"""
+
+import json
+import os
+
+BASE_TIME = 1700000000.0
+#: the `now` the tests pass to tail_summary/aggregate_processes
+FIXED_NOW = BASE_TIME + 123.0
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUN_DIR = os.path.join(HERE, "obs_run")
+
+
+def _hist(observed_ms):
+    """A LatencyHistogram snapshot built from fixed millisecond
+    observations (same arithmetic as obs/export.py, inlined so the
+    fixture never drifts with the implementation)."""
+    from bisect import bisect_left
+
+    buckets = [0.5 * 2 ** i for i in range(16)]
+    counts = [0] * (len(buckets) + 1)
+    for ms in observed_ms:
+        counts[bisect_left(buckets, ms)] += 1
+    return {"buckets_ms": buckets, "counts": counts,
+            "sum_ms": round(float(sum(observed_ms)), 3),
+            "count": len(observed_ms)}
+
+
+def replica_stats(idx: int) -> dict:
+    """One replica's full serve_* block (the /healthz payload shape)."""
+    n = 40 + 10 * idx
+    lat = [2.0 + 0.5 * i + idx for i in range(8)]
+    slat = [1.0 + 0.25 * i + idx for i in range(4)]
+    return {
+        "serve_requests": n,
+        "serve_responses": n - 2,
+        "serve_errors": 2,
+        "serve_server_errors": 1,
+        "serve_batches": 10 + idx,
+        "serve_dispatch_failures": idx,
+        "serve_bucket_splits": 1 + idx,
+        "serve_tier_splits": 2,
+        "serve_warm_splits": idx,
+        "serve_requests_by_tier": {"f32": n - 5, "bf16": 5},
+        "serve_responses_by_tier": {"f32": n - 7, "bf16": 5},
+        "serve_timeout_flushes": 3 + idx,
+        "serve_queue_depth": idx,
+        "serve_max_queue_depth": 6 + 2 * idx,
+        "serve_last_occupancy": 4,
+        "serve_occupancy_mean": 3.5 + idx,
+        "serve_max_batch": 8,
+        "serve_buckets": 2,
+        "serve_tiers": 2,
+        "serve_latency_p50_ms": 3.0 + idx,
+        "serve_latency_p99_ms": 8.0 + idx,
+        "serve_requests_per_s": 12.5 + idx,
+        "serve_sessions_active": 1 + idx,
+        "serve_sessions_created": 3 + idx,
+        "serve_sessions_resumed": idx,
+        "serve_sessions_expired": 1,
+        "serve_sessions_evicted": idx,
+        "serve_sessions_deleted": 1,
+        "serve_sessions_rebucketed": idx,
+        "serve_sessions_frames": 12 + idx,
+        "serve_sessions_steps": 9 + idx,
+        "serve_sessions_decode_saved": 9 + idx,
+        "serve_sessions_warm_steps": 4 + idx,
+        "serve_sessions_cold_fallbacks": 2,
+        "serve_sessions_warm_start": True,
+        "serve_session_latency_hist": _hist(slat),
+        "serve_session_latency_p50_ms": 2.0,
+        "serve_session_latency_p99_ms": 4.0,
+        "serve_latency_hist": _hist(lat),
+        "serve_slo": {"latency_ms": 8.0, "bucket_ms": 8.0,
+                      "error_budget": 0.01, "requests": n,
+                      "breaches": 1 + idx, "failures": 1,
+                      "bad_fraction": round((2 + idx) / n, 6),
+                      "burn": round((2 + idx) / n / 0.01, 4),
+                      "exhausted": True},
+    }
+
+
+def supervisor_block() -> dict:
+    """The fleet supervisor+router heartbeat's fleet_* block."""
+    return {
+        "fleet_replicas": 2,
+        "fleet_ready": 2,
+        "fleet_states": {"replica-0": "ready", "replica-1": "ready"},
+        "fleet_evictions": 1,
+        "fleet_crashes": 1,
+        "fleet_clean_exits": 0,
+        "fleet_wedge_evictions": 1,
+        "fleet_stale_evictions": 0,
+        "fleet_spawn_failures": 0,
+        "fleet_respawns": 1,
+        "fleet_broken": 0,
+        "fleet_kill_escalations": 0,
+        "fleet_requests": 90,
+        "fleet_responses": 86,
+        "fleet_errors": 4,
+        "fleet_server_errors": 2,
+        "fleet_failovers": 1,
+        "fleet_retries": 2,
+        "fleet_shed": 1,
+        "fleet_unavailable": 0,
+        "fleet_in_flight": 0,
+        "fleet_routed": {"replica-0": 46, "replica-1": 44},
+        "fleet_draining": False,
+        "fleet_sessions_sticky": 2,
+        "fleet_session_primes": 4,
+        "fleet_session_steps": 18,
+        "fleet_session_lost": 1,
+        "fleet_session_evicted": 0,
+        "fleet_session_expired": 1,
+        "fleet_latency_hist": _hist([3.0, 4.0, 5.0, 9.0]),
+    }
+
+
+def heartbeat(step: int, extra: dict) -> dict:
+    return {"time": BASE_TIME + 100.0, "pid": 4242 + step, "step": step,
+            "beats": 12, "last_step_age_s": 0.4,
+            "step_time_median_s": 0.05, "heartbeat_period_s": 5.0,
+            "wedged": False, "wedges": 0, "rss_bytes": 123456789,
+            "dev_mem_bytes_in_use": None, "dev_mem_peak_bytes": None,
+            **extra}
+
+
+def write(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        if isinstance(obj, list):  # jsonl
+            f.write("".join(json.dumps(r) + "\n" for r in obj))
+        else:
+            json.dump(obj, f)
+
+
+def main() -> None:
+    sup = supervisor_block()
+    write(os.path.join(RUN_DIR, "heartbeat.json"), heartbeat(0, sup))
+    write(os.path.join(RUN_DIR, "metrics.jsonl"), [
+        {"kind": "warn", "step": 0, "time": BASE_TIME + 10.0,
+         "message": "fleet: replica-0 evicted (wedged)"},
+        {"kind": "serve", "step": 0, "time": BASE_TIME + 110.0, **sup},
+    ])
+    for idx in range(2):
+        stats = replica_stats(idx)
+        d = os.path.join(RUN_DIR, f"replica-{idx}")
+        write(os.path.join(d, "heartbeat.json"),
+              heartbeat(10 + idx, stats))
+        write(os.path.join(d, "metrics.jsonl"), [
+            {"kind": "serve", "step": 10 + idx,
+             "time": BASE_TIME + 105.0, **stats},
+        ])
+        # the /healthz payload the scrape stubs serve (same block)
+        write(os.path.join(RUN_DIR, f"healthz-replica-{idx}.json"), stats)
+    print(f"wrote fixture run dir: {RUN_DIR}")
+
+
+if __name__ == "__main__":
+    main()

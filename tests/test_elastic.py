@@ -267,10 +267,8 @@ def test_elastic_config_round_trips_to_children():
     assert back.elastic.host_index == 2 and back.elastic.generation == 4
     assert back.resilience.faults.host_loss_at == (1,)
 
-    bad = dataclasses.asdict(cfg)
-    bad["elastic"]["hostz"] = 3
-    with pytest.raises(ValueError, match="hostz"):
-        config_from_dict(bad)
+    # typo rejection ("hostz") moved to the registry-driven whole-tree
+    # walk in test_lint.py, which keeps this assertion as a parity pin
 
 
 # ------------------------------- ckpt writer gating + restore provenance

@@ -130,11 +130,10 @@ def test_config_json_round_trip():
     assert restored == cfg
     assert restored.serve.buckets == ((64, 64), (32, 64))
     assert restored.resilience.faults.replica_crash_at == (0, 2)
-    with pytest.raises(ValueError):
-        config_from_dict({"not_a_field": 1})
-    with pytest.raises(ValueError, match="serve"):
-        # typos at ANY level must not silently become defaults
-        config_from_dict({"serve": {"fake_exec_sm": 5.0}})
+    # typo rejection (typos at ANY level must not silently become
+    # defaults) moved to the registry-driven whole-tree walk in
+    # test_lint.py, which keeps this file's original assertions as
+    # parity pins
 
 
 # ------------------------------------------------------- header probe

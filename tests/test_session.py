@@ -351,8 +351,8 @@ def test_session_config_round_trip_and_unknown_key_rejection():
         dataclasses.asdict(cfg))))
     assert restored == cfg
     assert restored.serve.session.max_sessions == 7
-    with pytest.raises(ValueError, match="session"):
-        config_from_dict({"serve": {"session": {"ttl_sec": 5.0}}})
+    # typo rejection ("ttl_sec") moved to the registry-driven whole-tree
+    # walk in test_lint.py, which keeps this assertion as a parity pin
 
 
 # ---------------------------------------------- router (stub fleet)

@@ -266,12 +266,10 @@ def test_warm_config_round_trip_and_typo_rejection_every_level():
     assert restored == cfg
     assert restored.serve.session.warm_start is True
     assert restored.serve.session.warm_width == 0.25
-    with pytest.raises(ValueError, match="session"):
-        config_from_dict({"serve": {"session": {"warm_stat": True}}})
-    with pytest.raises(ValueError, match="serve"):
-        config_from_dict({"serve": {"session_warm_start": True}})
-    with pytest.raises(ValueError, match="warm_start"):
-        config_from_dict({"warm_start": True})
+    # typo rejection at every nesting level ("warm_stat" /
+    # "session_warm_start" / top-level "warm_start") moved to the
+    # registry-driven whole-tree walk in test_lint.py, which keeps
+    # these assertions as parity pins
 
 
 # ----------------------------------------------------- observability
