@@ -135,6 +135,50 @@ def _elastic_counters(rec: dict) -> dict:
             if k.startswith("elastic_") and v is not None}
 
 
+#: Per-pyramid-scale loss-decomposition record fields (train/loop.py
+#: writes them into every periodic train record, finest scale first).
+_SCALE_FIELDS = ("loss_total_by_scale", "loss_photo_by_scale",
+                 "loss_smooth_by_scale")
+
+
+def eval_trend(evals: list[dict], window: int = 8,
+               regress_tol: float = 0.02) -> dict | None:
+    """Eval-EPE trend over the newest `window` eval records: the
+    least-squares slope of AEE vs step (per 1000 steps — a readable
+    unit at any eval cadence) plus a regression flag. `regressing` is
+    True when the recent slope is positive AND the newest AEE sits more
+    than `regress_tol` above the run's best — one noisy eval above best
+    does not flag, a sustained climb does. This is the signal an
+    EPE-driven curriculum switch point consumes (ROADMAP item 3): a
+    plateaued-or-regressing stage is what triggers the next stage."""
+    pts = [(r["step"], r["aee"]) for r in evals
+           if isinstance(r.get("step"), int)
+           and isinstance(r.get("aee"), (int, float))
+           and math.isfinite(r["aee"])]
+    if len(pts) < 3:
+        return None
+    recent = pts[-max(int(window), 3):]
+    xs = [p[0] for p in recent]
+    ys = [p[1] for p in recent]
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= 0:
+        return None
+    slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+    best = min(y for _, y in pts)
+    last = pts[-1][1]
+    return {
+        "window": n,
+        "slope_aee_per_kstep": round(slope * 1e3, 6),
+        "last_aee": last,
+        "best_aee": best,
+        "regressing": bool(slope > 0
+                           and last > best * (1.0 + float(regress_tol))),
+    }
+
+
 def summarize(records: list[dict]) -> dict:
     by_kind: dict[str, list[dict]] = defaultdict(list)
     for r in records:
@@ -158,6 +202,12 @@ def summarize(records: list[dict]) -> dict:
             "last_lr": last.get("lr"),
             "items_per_sec_per_chip": last.get("items_per_sec_per_chip"),
         }
+        # per-pyramid-scale loss decomposition from the newest record
+        # (finest first): where the objective's mass sits — photometric
+        # vs smoothness, coarse vs fine — not just its total
+        for field in _SCALE_FIELDS:
+            if isinstance(last.get(field), list):
+                out["train"][field] = last[field]
         # phase/counter aggregation rides on the freshest train record
         # (phase_*_s / starved / data_* fields are cumulative totals)
         newest = raw_train[-1]
@@ -178,6 +228,9 @@ def summarize(records: list[dict]) -> dict:
             "best_step": best["step"],
             "last_aae": evals[-1].get("aae"),
         }
+        trend = eval_trend(evals)
+        if trend:
+            out["eval_trend"] = trend
     accs = _finite(by_kind.get("eval", []), "accuracy")
     if accs:
         best = max(accs, key=lambda r: r["accuracy"])

@@ -297,7 +297,10 @@ def main(argv=None) -> int:
                      "a serving fleet evicted or broke a replica, 5 "
                      "when an elastic run lost a host and re-formed, 6 "
                      "when the SLO error budget is exhausted "
-                     "(obs.slo_latency_ms / obs.slo_error_budget)")
+                     "(obs.slo_latency_ms / obs.slo_error_budget), 7 "
+                     "when the label-free flow-quality drift verdict "
+                     "fired (obs.quality_sample_rate / obs.quality_budget"
+                     " — with --fleet, any replica's verdict counts)")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
@@ -409,6 +412,19 @@ def main(argv=None) -> int:
                    or (summary.get("fleet") or {}).get("slo") or {})
             if slo.get("exhausted"):
                 return 6
+            # rc 7 when the label-free flow-quality drift verdict fired
+            # (obs/quality.py): post-reference photometric-proxy
+            # breaches overran obs.quality_budget — latency and errors
+            # may look perfect while the FLOWS are degrading (quantized
+            # tier drift, damaged weights). With --fleet, any child
+            # replica's verdict counts: the degraded replica's quality
+            # block lives in its own process dir, not the router's.
+            quality_blocks = [(summary.get("serve") or {}).get("quality")]
+            quality_blocks += [
+                (child.get("serve") or {}).get("quality")
+                for child in (summary.get("processes") or {}).values()]
+            if any((q or {}).get("exhausted") for q in quality_blocks):
+                return 7
             if not args.follow:
                 return 0
             import time as _time

@@ -295,6 +295,31 @@ class ObsConfig:
     # their existing ports instead). None = off; 0 = ephemeral port
     # (announced on stdout); > 0 = that port.
     metrics_port: int | None = None
+    # --- Label-free flow-quality observability (obs/quality.py,
+    # DESIGN.md "Quality observability") ---
+    # Fraction of served requests scored with the label-free quality
+    # proxy (Charbonnier photometric error on warp(frame2, flow) vs
+    # frame1, census distance, flow-smoothness magnitude) OFF the hot
+    # path: sampled rows go to a bounded queue + one scorer thread; a
+    # full queue drops the sample (counted), never blocks a response.
+    # 0 = off — the serve path is then bitwise- and schema-unchanged.
+    # Sampling is deterministic in (quality_seed, request index).
+    quality_sample_rate: float = 0.0
+    quality_seed: int = 0
+    # Scorer-queue bound: samples waiting to be scored (each holds one
+    # preprocessed input row + one flow row). Full = drop-and-count.
+    quality_queue_depth: int = 128
+    # Drift detection: the first quality_ref_samples scored requests
+    # freeze a reference median photometric proxy; afterwards a sample
+    # whose photo proxy exceeds ref_p50 * quality_drift_factor is a
+    # BREACH, and breaches / post-reference samples burn quality_budget
+    # (the SLO error-budget pattern). Exhaustion => `tail` exit code 7.
+    # quality_window bounds the rolling current-p50 window the verdict
+    # reports alongside the reference.
+    quality_ref_samples: int = 64
+    quality_window: int = 256
+    quality_drift_factor: float = 2.0
+    quality_budget: float = 0.1
 
 
 @dataclass(frozen=True)

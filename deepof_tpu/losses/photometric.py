@@ -308,8 +308,12 @@ def loss_interp(
     v_loss = v_loss * level_on
     total = photo + cfg.lambda_smooth * (u_loss + v_loss)
     return (
+        # "smooth" aliases U+V as one number — the per-scale training
+        # telemetry's smoothness component ("Models Matter, So Does
+        # Training": the loss-term decomposition is what predicts EPE);
+        # the reference-named keys stay untouched for parity consumers
         {"total": total, "Charbonnier_reconstruct": photo,
-         "U_loss": u_loss, "V_loss": v_loss},
+         "U_loss": u_loss, "V_loss": v_loss, "smooth": u_loss + v_loss},
         recon,
     )
 
@@ -407,6 +411,6 @@ def loss_interp_multi(
     total = photo + cfg.lambda_smooth * (u_loss + v_loss)
     return (
         {"total": total, "Charbonnier_reconstruct": photo,
-         "U_loss": u_loss, "V_loss": v_loss},
+         "U_loss": u_loss, "V_loss": v_loss, "smooth": u_loss + v_loss},
         recon,
     )

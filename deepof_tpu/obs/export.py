@@ -54,8 +54,56 @@ from typing import Callable
 LATENCY_BUCKETS_MS: tuple[float, ...] = tuple(0.5 * 2 ** i
                                               for i in range(16))
 
+#: Fixed log-spaced bounds for the label-free flow-QUALITY proxies
+#: (obs/quality.py): dimensionless Charbonnier/census/smoothness values,
+#: powers of two from ~0.001 to 1024. Same contract as the latency
+#: bounds: never config-derived, so replica quality histograms merge
+#: EXACTLY at the router. NOTE: quality snapshots reuse the histogram
+#: snapshot schema ("buckets_ms"/"sum_ms" keys) for merge/percentile
+#: machinery compatibility — the bounds are raw proxy units, not
+#: milliseconds (the Prometheus renderer drops the _ms suffix for any
+#: non-latency bounds).
+QUALITY_BUCKETS: tuple[float, ...] = tuple(2.0 ** i for i in range(-10, 11))
 
-class LatencyHistogram:
+
+class ValueHistogram:
+    """Thread-safe fixed-bucket histogram over arbitrary nonnegative
+    values. The bounds are fixed BY THE CALLER'S CONTRACT (a shared
+    module constant, never config/data-derived), which is what makes two
+    processes' snapshots merge exactly. O(1) observe."""
+
+    def __init__(self, bounds: tuple[float, ...] = LATENCY_BUCKETS_MS,
+                 sum_digits: int = 6):
+        self._bounds = tuple(float(b) for b in bounds)
+        self._sum_digits = int(sum_digits)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self._bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = max(float(value), 0.0)
+        idx = bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        """JSON-ready state: {"buckets_ms", "counts", "sum_ms",
+        "count"}. `counts` are per-bucket (NOT cumulative) so snapshots
+        merge by element-wise addition; the Prometheus renderer
+        cumulates at render time. Key names carry "_ms" for schema
+        stability across every consumer — for non-latency bounds the
+        values are raw units (see QUALITY_BUCKETS note)."""
+        with self._lock:
+            return {"buckets_ms": list(self._bounds),
+                    "counts": list(self._counts),
+                    "sum_ms": round(self._sum, self._sum_digits),
+                    "count": self._count}
+
+
+class LatencyHistogram(ValueHistogram):
     """Thread-safe fixed-bucket latency histogram (see module docstring).
 
     `observe` takes seconds (every latency in this repo is monotonic
@@ -63,29 +111,10 @@ class LatencyHistogram:
     percentiles already use)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)  # last = +Inf
-        self._sum_ms = 0.0
-        self._count = 0
+        super().__init__(LATENCY_BUCKETS_MS, sum_digits=3)
 
     def observe(self, seconds: float) -> None:
-        ms = max(float(seconds), 0.0) * 1e3
-        idx = bisect_left(LATENCY_BUCKETS_MS, ms)
-        with self._lock:
-            self._counts[idx] += 1
-            self._sum_ms += ms
-            self._count += 1
-
-    def snapshot(self) -> dict:
-        """JSON-ready state: {"buckets_ms", "counts", "sum_ms",
-        "count"}. `counts` are per-bucket (NOT cumulative) so snapshots
-        merge by element-wise addition; the Prometheus renderer
-        cumulates at render time."""
-        with self._lock:
-            return {"buckets_ms": list(LATENCY_BUCKETS_MS),
-                    "counts": list(self._counts),
-                    "sum_ms": round(self._sum_ms, 3),
-                    "count": self._count}
+        super().observe(max(float(seconds), 0.0) * 1e3)
 
 
 def percentile_ms(hist: dict | None, frac: float) -> float | None:
@@ -117,9 +146,17 @@ def is_hist_snapshot(value) -> bool:
 
 def merge_hists(snapshots: list[dict]) -> dict:
     """Element-wise EXACT merge of histogram snapshots — the fleet
-    aggregation primitive. Raises ValueError on a bucket-bound mismatch
-    (a foreign histogram must fail loudly, not merge approximately)."""
-    buckets = list(LATENCY_BUCKETS_MS)
+    aggregation primitive. Every snapshot in the set must share one
+    internally consistent bound layout (the latency buckets, the quality
+    buckets — any fixed-by-contract set); a mismatch within the set, or
+    a bounds/counts length mismatch, raises ValueError — a foreign
+    histogram must fail loudly, not merge approximately."""
+    if not snapshots:
+        raise ValueError("merge_hists: empty snapshot list")
+    first = snapshots[0]
+    if not is_hist_snapshot(first):
+        raise ValueError(f"not a histogram snapshot: {first!r}")
+    buckets = list(first["buckets_ms"])
     counts = [0] * (len(buckets) + 1)
     sum_ms = 0.0
     count = 0
@@ -135,7 +172,10 @@ def merge_hists(snapshots: list[dict]) -> dict:
         sum_ms += float(s["sum_ms"])
         count += int(s["count"])
     return {"buckets_ms": buckets, "counts": counts,
-            "sum_ms": round(sum_ms, 3), "count": count}
+            # 6 digits, not 3: quality-proxy sums are dimensionless and
+            # can sit at 1e-4 scale per sample (ValueHistogram's
+            # sum_digits=6) — a 3-digit merge would zero them fleet-wide
+            "sum_ms": round(sum_ms, 6), "count": count}
 
 
 # ------------------------------------------------------------------ SLO
@@ -239,7 +279,9 @@ def render_prometheus(stats: dict, namespace: str = "deepof") -> str:
       histogram snapshot   -> `ns_base_bucket{le=...}` CUMULATIVE counts
                               (+Inf last) + `ns_base_sum` + `ns_base_count`,
                               where base strips a trailing `_hist` and
-                              appends `_ms` (the unit of the bounds)
+                              appends `_ms` for latency-bounded
+                              histograms (quality histograms keep raw
+                              dimensionless names)
       None / other         -> skipped
 
     Deterministic output ordering (sorted keys) so scrapes diff cleanly.
@@ -252,7 +294,13 @@ def render_prometheus(stats: dict, namespace: str = "deepof") -> str:
         name = f"{_sanitize(namespace)}_{_sanitize(key)}"
         if is_hist_snapshot(value):
             base = key[:-len("_hist")] if key.endswith("_hist") else key
-            base = f"{_sanitize(namespace)}_{_sanitize(base)}_ms"
+            # the "_ms" unit suffix belongs only to latency histograms;
+            # quality histograms (QUALITY_BUCKETS bounds) carry raw
+            # dimensionless proxy values despite the snapshot's schema
+            # key names (see QUALITY_BUCKETS note)
+            unit = ("_ms" if list(value["buckets_ms"])
+                    == list(LATENCY_BUCKETS_MS) else "")
+            base = f"{_sanitize(namespace)}_{_sanitize(base)}{unit}"
             lines.append(f"# TYPE {base} histogram")
             cum = 0
             for bound, c in zip(value["buckets_ms"], value["counts"]):

@@ -1,0 +1,457 @@
+"""Label-free flow-quality observability (DESIGN.md "Quality
+observability").
+
+The paper's core insight is that flow quality is measurable WITHOUT
+ground truth: warp the second frame backward by the predicted flow and
+score the photometric error against the first frame (PAPER.md §0 — the
+training objective itself is this proxy). The serve stack observes
+latency, throughput, and SLO burn fleet-wide, but has been blind to
+whether the *flows* are degrading in production — a quantized tier's
+drift, a stale warm-start prior, a corrupted replica's weights. This
+module closes that axis with three per-request proxies, computed on a
+sampled fraction of served requests, OFF the hot path:
+
+  photo    mean generalized-Charbonnier photometric error of
+           warp(frame2, flow) vs frame1 over the border-mask interior —
+           the paper's objective as a serving metric (lower = better
+           reconstruction = better flow, modulo occlusion).
+  census   mean soft census-transform distance (ops/census.py) between
+           the warped frame and frame1 — the illumination-robust twin of
+           `photo`: a brightness change moves `photo` but not `census`,
+           so the PAIR distinguishes "flows degraded" from "the video
+           got darker".
+  smooth   mean first-difference magnitude of the flow field
+           (ops/smoothness.py semantics) — a collapsing or exploding
+           flow head moves this even when photometric error looks fine
+           (e.g. zero flow on a static scene).
+
+Architecture (the hot-path contract):
+
+  - **Sampling is deterministic.** `QualitySampler` decides per
+    ACCEPTED-request index via a seeded hash, so the sampled set is a
+    pure function of (seed, rate, submission order) — identical at any
+    worker count, reproducible across replicas given the same stream.
+  - **Scoring never blocks a response.** Sampled rows are copied onto a
+    BOUNDED queue consumed by one scorer thread; a full queue DROPS the
+    sample and counts it (`serve_quality_dropped`) — a wedged scorer
+    costs samples, never latency.
+  - **One jitted scorer executable per bucket**, lowered from the same
+    `make_score_fn` + `quality_avals` pair `warmup --serve` pre-lowers,
+    so sampling never compiles on a live endpoint. Engines running a
+    custom/fake executor (jax-free fleet replicas) score through the
+    numpy reference implementation instead — same math, no jax import.
+  - **Fixed-bound histograms** (obs/export.py QUALITY_BUCKETS) make the
+    per-replica quality distributions merge EXACTLY at the router, like
+    the latency histograms. Per-(tier, mode) sum/count maps make int8-
+    vs-f32 and warm-vs-cold quality drift visible in production, not
+    just in bench.
+  - **Drift verdict with a budget.** The first `quality_ref_samples`
+    scored requests freeze a reference median; after that, every sample
+    whose `photo` exceeds `ref_p50 * quality_drift_factor` burns the
+    `obs.quality_budget` (breach fraction / budget, the SLO pattern).
+    Exhaustion is the bit `deepof_tpu tail` turns into exit code 7.
+
+Import discipline: stdlib + numpy at module level (this module is
+imported by the serve engine, never by analyze/tail); jax enters only
+inside `make_score_fn` / the engine's lowering path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import zlib
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from .export import QUALITY_BUCKETS, ValueHistogram, percentile_ms
+
+#: Charbonnier parameters of the photometric proxy — the reference
+#: loss's (epsilon, alpha_c) pair (core/config.py LossConfig defaults),
+#: fixed here so the proxy is comparable across configs and replicas.
+PHOTO_EPS = 1e-4
+PHOTO_ALPHA = 0.25
+#: Border-mask ratio excluded from the photometric/census means (warp
+#: border clamping pollutes the edge band — losses/photometric.py).
+BORDER_RATIO = 0.1
+#: Census window of the quality proxy (ops/census.py default).
+CENSUS_WINDOW = 7
+
+#: TF grayscale weights on BGR channels — ops/smoothness._GRAY_WEIGHTS,
+#: repeated here so the numpy path needs no jax-importing module.
+_GRAY = np.array([0.2989, 0.587, 0.114], np.float32)
+
+
+# ------------------------------------------------------------- sampling
+
+
+class QualitySampler:
+    """Deterministic seeded Bernoulli sampler over request indices.
+
+    `sample(i)` is a pure function of (seed, i): a crc32 hash mapped to
+    [0, 1) compared against the rate — the same contract the fault
+    injector uses for its probability schedules, so the sampled SET is
+    identical for any pipeline worker count or scorer backlog, and two
+    replicas given the same request stream sample the same requests."""
+
+    def __init__(self, rate: float, seed: int = 0):
+        self.rate = min(max(float(rate), 0.0), 1.0)
+        self.seed = int(seed)
+
+    def sample(self, index: int) -> bool:
+        if self.rate <= 0.0:
+            return False
+        if self.rate >= 1.0:
+            return True
+        h = zlib.crc32(f"q:{self.seed}:{int(index)}".encode())
+        return h / 2**32 < self.rate
+
+
+# ------------------------------------------- the proxy (numpy reference)
+
+
+def _border_interior(h: int, w: int, extra: int = 0) -> tuple[slice, slice]:
+    """Interior slice pair of the border mask (losses border_mask
+    semantics: width = ceil(BORDER_RATIO * h), widened by `extra`)."""
+    bw = int(np.ceil(h * BORDER_RATIO)) + max(int(extra), 0)
+    bw = min(bw, max((min(h, w) - 1) // 2, 0))
+    return slice(bw, h - bw or None), slice(bw, w - bw or None)
+
+
+def _resize_np(img: np.ndarray, hw: tuple[int, int]) -> np.ndarray:
+    """Half-pixel-centered bilinear resize (cv2, matching
+    jax.image.resize 'bilinear' within float tolerance)."""
+    if img.shape[:2] == tuple(hw):
+        return img
+    import cv2
+
+    return cv2.resize(img, (hw[1], hw[0]), interpolation=cv2.INTER_LINEAR)
+
+
+def warp_bilinear_np(image: np.ndarray, flow: np.ndarray) -> np.ndarray:
+    """Backward warp (H, W, C) by (H, W, 2), clip-at-border bilinear —
+    the numpy twin of ops/warp.backward_warp (same u/v convention, same
+    independent neighbor clipping)."""
+    h, w = image.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    fx = xs + flow[..., 0]
+    fy = ys + flow[..., 1]
+    x0 = np.floor(fx).astype(np.int64)
+    y0 = np.floor(fy).astype(np.int64)
+    wx = (fx - x0)[..., None]
+    wy = (fy - y0)[..., None]
+    x0c = np.clip(x0, 0, w - 1)
+    x1c = np.clip(x0 + 1, 0, w - 1)
+    y0c = np.clip(y0, 0, h - 1)
+    y1c = np.clip(y0 + 1, 0, h - 1)
+    ia = image[y0c, x0c]
+    ib = image[y1c, x0c]
+    ic = image[y0c, x1c]
+    id_ = image[y1c, x1c]
+    return (ia * (1 - wx) * (1 - wy) + ib * (1 - wx) * wy
+            + ic * wx * (1 - wy) + id_ * wx * wy)
+
+
+def census_descriptors_np(gray255: np.ndarray, window: int = CENSUS_WINDOW,
+                          eps: float = 0.81) -> np.ndarray:
+    """(H, W, 1) grayscale intensities -> (H, W, window**2) soft census
+    descriptors — the numpy twin of ops/census.census_transform (edge
+    padding, normalized differences)."""
+    h, w = gray255.shape[:2]
+    r = window // 2
+    padded = np.pad(gray255, ((r, r), (r, r), (0, 0)), mode="edge")
+    shifted = [padded[dy:dy + h, dx:dx + w, :]
+               for dy in range(window) for dx in range(window)]
+    d = np.concatenate(shifted, axis=-1) - gray255
+    return d / np.sqrt(eps + np.square(d))
+
+
+def census_distance_np(a: np.ndarray, b: np.ndarray,
+                       thresh: float = 0.1) -> np.ndarray:
+    d2 = np.square(a - b)
+    return np.sum(d2 / (thresh + d2), axis=-1, keepdims=True)
+
+
+def score_pair_np(x: np.ndarray, flow: np.ndarray,
+                  census_window: int = CENSUS_WINDOW) -> tuple[float, float, float]:
+    """The (photo, smooth, census) proxy triple for ONE served request —
+    numpy reference implementation (and the scorer used by jax-free
+    custom/fake-executor engines).
+
+    x: (H, W, 6) the preprocessed network-input row ((img - mean)/255
+       BGR, serve/buckets.prepare_pair) — frame1 in channels 0:3,
+       frame2 in 3:6.
+    flow: (fh, fw, 2) the raw dispatch output — the finest scaled flow
+       at the head grid; displacement units are head-grid pixels (the
+       loss's convention at that level), so frames are resized DOWN to
+       the flow grid before warping, exactly as loss_interp resizes.
+    """
+    fh, fw = flow.shape[:2]
+    f1 = _resize_np(np.ascontiguousarray(x[..., :3], np.float32), (fh, fw))
+    f2 = _resize_np(np.ascontiguousarray(x[..., 3:6], np.float32), (fh, fw))
+    recon = warp_bilinear_np(f2, flow.astype(np.float32))
+    ys, xs = _border_interior(fh, fw)
+    diff = 255.0 * (recon - f1)
+    photo = float(np.mean(
+        np.power(np.square(diff[ys, xs]) + PHOTO_EPS ** 2, PHOTO_ALPHA)))
+    # smoothness: mean first-difference magnitude of the flow field
+    # (forward_diff semantics; last row/col invalid, excluded)
+    du = flow[:, :-1, :] - flow[:, 1:, :]
+    dv = flow[:-1, :, :] - flow[1:, :, :]
+    smooth = float((np.mean(np.abs(du)) + np.mean(np.abs(dv))) / 2.0)
+    g1 = np.tensordot(f1 * 255.0, _GRAY, axes=[[-1], [0]])[..., None]
+    gr = np.tensordot(recon * 255.0, _GRAY, axes=[[-1], [0]])[..., None]
+    cys, cxs = _border_interior(fh, fw, extra=census_window // 2)
+    dist = census_distance_np(
+        census_descriptors_np(gr, census_window),
+        census_descriptors_np(g1, census_window))
+    census = float(np.mean(dist[cys, cxs]))
+    return photo, smooth, census
+
+
+# --------------------------------------------------- the proxy (jitted)
+
+
+def make_score_fn(census_window: int = CENSUS_WINDOW) -> Callable:
+    """(x[B,H,W,6], flow[B,fh,fw,2]) -> [3] float32 (photo, smooth,
+    census means over the batch) — the jitted scorer the engine lowers
+    once per bucket and `warmup --serve` pre-lowers identically (shared
+    definition = shared persistent-cache key). Same math as
+    score_pair_np, over the repo's jnp ops (ops/warp, ops/census)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.census import census_distance, census_transform
+    from ..ops.warp import backward_warp
+
+    def score(x, flow):
+        b, fh, fw = flow.shape[0], flow.shape[1], flow.shape[2]
+        # antialias=False = plain half-pixel bilinear, the same samples
+        # cv2.INTER_LINEAR takes — the numpy reference path and this one
+        # agree to float precision at any grid (parity-pinned in tests)
+        f1 = jax.image.resize(x[..., :3], (b, fh, fw, 3), "bilinear",
+                              antialias=False)
+        f2 = jax.image.resize(x[..., 3:6], (b, fh, fw, 3), "bilinear",
+                              antialias=False)
+        recon = backward_warp(f2, flow, impl="xla")
+        ys, xs = _border_interior(fh, fw)
+        diff = 255.0 * (recon - f1)
+        photo = jnp.mean(jnp.power(
+            jnp.square(diff[:, ys, xs, :]) + PHOTO_EPS ** 2, PHOTO_ALPHA))
+        du = flow[:, :, :-1, :] - flow[:, :, 1:, :]
+        dv = flow[:, :-1, :, :] - flow[:, 1:, :, :]
+        smooth = (jnp.mean(jnp.abs(du)) + jnp.mean(jnp.abs(dv))) / 2.0
+        dist = census_distance(census_transform(recon, census_window),
+                               census_transform(f1, census_window))
+        cys, cxs = _border_interior(fh, fw, extra=census_window // 2)
+        census = jnp.mean(dist[:, cys, cxs, :])
+        return jnp.stack([photo, smooth, census]).astype(jnp.float32)
+
+    return score
+
+
+def quality_avals(bucket: tuple[int, int], flow_hw: tuple[int, int]):
+    """(x_sds, flow_sds) for one bucket's scorer executable — shared by
+    the engine's lowering and `warmup --serve` so their persistent-cache
+    keys match. Batch 1: scoring is per sampled request, off-path."""
+    import jax
+
+    x_sds = jax.ShapeDtypeStruct((1, bucket[0], bucket[1], 6), np.float32)
+    flow_sds = jax.ShapeDtypeStruct((1, flow_hw[0], flow_hw[1], 2),
+                                    np.float32)
+    return x_sds, flow_sds
+
+
+# -------------------------------------------------------------- scorer
+
+
+class QualityScorer:
+    """Sampled off-hot-path quality scoring for one engine (see module
+    docstring).
+
+    score_fn: (bucket, x[1,H,W,6], flow[1,fh,fw,2]) -> (photo, smooth,
+        census) floats. The engine provides either the jitted per-bucket
+        executable path or the numpy reference (custom/fake executors).
+    All configuration comes from ObsConfig's quality_* knobs.
+    """
+
+    def __init__(self, score_fn: Callable, sample_rate: float,
+                 seed: int = 0, queue_depth: int = 128,
+                 ref_samples: int = 64, window: int = 256,
+                 drift_factor: float = 2.0, budget: float = 0.1):
+        self.sampler = QualitySampler(sample_rate, seed)
+        self._score_fn = score_fn
+        self._ref_samples = max(int(ref_samples), 1)
+        self._drift_factor = max(float(drift_factor), 1.0)
+        self._budget = max(float(budget), 1e-9)
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(queue_depth), 1))
+        self._lock = threading.Lock()
+        self._sampled = 0   # accepted onto the queue
+        self._dropped = 0   # queue full: sample lost, response unaffected
+        self._scored = 0    # scorer completed
+        self._errors = 0    # scorer raised (counted, thread survives)
+        self._breaches = 0  # post-reference photo > ref_p50 * factor
+        self._post_ref = 0  # scored samples after the reference froze
+        self._ref: list[float] = []     # photo values building the reference
+        self._ref_p50: float | None = None
+        self._window: deque = deque(maxlen=max(int(window), 8))
+        self._hists = {"photo": ValueHistogram(QUALITY_BUCKETS),
+                       "smooth": ValueHistogram(QUALITY_BUCKETS),
+                       "census": ValueHistogram(QUALITY_BUCKETS)}
+        # per-(tier/mode) sum/count maps: the axis that makes int8 and
+        # warm-start drift visible per operating point (maps merge
+        # key-wise at the router, so the fleet view stays exact; a mean
+        # per key re-derives as sum / scored at any aggregation level)
+        self._scored_by_key: dict[str, int] = {}
+        self._photo_sum_by_key: dict[str, float] = {}
+        self._smooth_sum_by_key: dict[str, float] = {}
+        self._census_sum_by_key: dict[str, float] = {}
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-quality-scorer")
+        self._thread.start()
+
+    # ------------------------------------------------------------ intake
+    def should_sample(self, index: int) -> bool:
+        return self.sampler.sample(index)
+
+    def submit(self, x_row: np.ndarray, flow_row: np.ndarray,
+               bucket: tuple[int, int], tier: str, mode: str) -> bool:
+        """Hand one sampled request's (input row, raw flow output) to
+        the scorer thread. NEVER blocks: a full queue drops the sample
+        and counts it. Rows are copied by the caller (they must not
+        alias the batcher's reusable buffers)."""
+        try:
+            self._q.put_nowait((x_row, flow_row, tuple(bucket), str(tier),
+                                str(mode)))
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+            return False
+        with self._lock:
+            self._sampled += 1
+        return True
+
+    # ------------------------------------------------------------ scorer
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            x_row, flow_row, bucket, tier, mode = item
+            try:
+                photo, smooth, census = self._score_fn(
+                    bucket, x_row[None], flow_row[None])
+            except Exception:  # noqa: BLE001 - scoring must not die; counted
+                with self._lock:
+                    self._errors += 1
+                continue
+            self._observe(float(photo), float(smooth), float(census),
+                          f"{tier}/{mode}")
+
+    def _observe(self, photo: float, smooth: float, census: float,
+                 key: str) -> None:
+        self._hists["photo"].observe(photo)
+        self._hists["smooth"].observe(smooth)
+        self._hists["census"].observe(census)
+        with self._lock:
+            self._scored += 1
+            self._scored_by_key[key] = self._scored_by_key.get(key, 0) + 1
+            for sums, v in ((self._photo_sum_by_key, photo),
+                            (self._smooth_sum_by_key, smooth),
+                            (self._census_sum_by_key, census)):
+                sums[key] = round(sums.get(key, 0.0) + v, 6)
+            if self._ref_p50 is None:
+                self._ref.append(photo)
+                if len(self._ref) >= self._ref_samples:
+                    self._ref_p50 = float(np.median(self._ref))
+                return
+            self._post_ref += 1
+            self._window.append(photo)
+            if photo > self._ref_p50 * self._drift_factor:
+                self._breaches += 1
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """The serve_quality_* block (engine.stats() merges it; only
+        present when sampling is configured on, so sample_rate=0 keeps
+        the serve schema byte-identical)."""
+        with self._lock:
+            scored = self._scored
+            post = self._post_ref
+            breaches = self._breaches
+            ref_p50 = self._ref_p50
+            cur = list(self._window)
+            out = {
+                "serve_quality_sample_rate": self.sampler.rate,
+                "serve_quality_sampled": self._sampled,
+                "serve_quality_dropped": self._dropped,
+                "serve_quality_scored": scored,
+                "serve_quality_errors": self._errors,
+                "serve_quality_breaches": breaches,
+                "serve_quality_scored_by_key": dict(self._scored_by_key),
+                "serve_quality_photo_sum_by_key":
+                    dict(self._photo_sum_by_key),
+                "serve_quality_smooth_sum_by_key":
+                    dict(self._smooth_sum_by_key),
+                "serve_quality_census_sum_by_key":
+                    dict(self._census_sum_by_key),
+            }
+        hists = {k: h.snapshot() for k, h in self._hists.items()}
+        out["serve_quality_photo_hist"] = hists["photo"]
+        out["serve_quality_smooth_hist"] = hists["smooth"]
+        out["serve_quality_census_hist"] = hists["census"]
+        out["serve_quality_photo_p50"] = percentile_ms(hists["photo"], 0.50)
+        out["serve_quality_smooth_p50"] = percentile_ms(hists["smooth"], 0.50)
+        out["serve_quality_census_p50"] = percentile_ms(hists["census"], 0.50)
+        # the drift verdict (derived — per-replica; the fleet re-derives
+        # from the merged breach/scored counters if it wants one number)
+        bad_fraction = (breaches / post) if post else 0.0
+        cur_p50 = float(np.median(cur)) if cur else None
+        out["serve_quality"] = {
+            "sample_rate": self.sampler.rate,
+            "scored": scored,
+            "ref_samples": min(scored, self._ref_samples),
+            "ref_p50": round(ref_p50, 6) if ref_p50 is not None else None,
+            "current_p50": (round(cur_p50, 6) if cur_p50 is not None
+                            else None),
+            "drift_ratio": (round(cur_p50 / ref_p50, 4)
+                            if cur_p50 is not None and ref_p50 else None),
+            "drift_factor": self._drift_factor,
+            "breaches": breaches,
+            "bad_fraction": round(bad_fraction, 6),
+            "budget": round(self._budget, 6),
+            "burn": round(bad_fraction / self._budget, 4),
+            "exhausted": bool(post and bad_fraction >= self._budget),
+        }
+        return out
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait (bounded) until every accepted sample has been scored —
+        test/bench quiescence, never called on the serve path."""
+        import time
+
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._scored + self._errors >= self._sampled:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            # behind any queued samples: the scorer drains, then exits.
+            # A WEDGED scorer's full queue must not block close (the
+            # drop-not-block contract applies to shutdown too): the
+            # thread is a daemon, skipping the sentinel just abandons it.
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+        self._thread.join(timeout=10.0)

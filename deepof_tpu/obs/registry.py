@@ -69,9 +69,9 @@ class Key:
     name: the full key as written into stats dicts ("serve_requests");
         for a prefix family, the shared prefix ("fault_").
     kind: merge kind (see module docstring).
-    owner: the subsystem that writes it — engine | session | server |
-        router | fleet | elastic | data | resilience | ckpt | faults |
-        train.
+    owner: the subsystem that writes it — engine | session | quality |
+        server | router | fleet | elastic | data | resilience | ckpt |
+        faults | train.
     prefix: True = family entry: every key starting with `name`
         resolves here (dynamically named counters — per-site fault
         counts). Exact entries always win over families.
@@ -123,6 +123,23 @@ _ENTRIES: list[Key] = [
     Key("serve_session_latency_hist", "hist", "session"),
     *_keys("session", "derived",
            "serve_session_latency_p50_ms", "serve_session_latency_p99_ms"),
+    # --------------------- serve_quality_* (label-free flow quality,
+    # obs/quality.py: sampled photometric/census/smoothness proxies)
+    *_keys("quality", "sum",
+           "serve_quality_sampled", "serve_quality_dropped",
+           "serve_quality_scored", "serve_quality_errors",
+           "serve_quality_breaches"),
+    Key("serve_quality_sample_rate", "gauge", "quality"),
+    *_keys("quality", "map",
+           "serve_quality_scored_by_key", "serve_quality_photo_sum_by_key",
+           "serve_quality_smooth_sum_by_key",
+           "serve_quality_census_sum_by_key"),
+    *_keys("quality", "hist",
+           "serve_quality_photo_hist", "serve_quality_smooth_hist",
+           "serve_quality_census_hist"),
+    *_keys("quality", "derived",
+           "serve_quality", "serve_quality_photo_p50",
+           "serve_quality_smooth_p50", "serve_quality_census_p50"),
     # ------------------------------ serve_* written by the fleet scrape
     *_keys("router", "sum",
            "serve_replicas_scraped", "serve_replicas_scrape_failed"),

@@ -87,6 +87,14 @@ class FaultConfig:
     # entry with a small replica_fault_after.
     replica_crash_at: tuple[int, ...] = ()
     replica_wedge_at: tuple[int, ...] = ()
+    # replica_degrade: every dispatch AFTER the arm point returns
+    # deliberately corrupted flow (a large constant offset) — the
+    # deterministic stand-in for silently damaged weights (bad quantized
+    # tier, bit-rotted checkpoint). The replica keeps serving and stays
+    # healthy on every latency/SLO axis; ONLY the label-free quality
+    # proxies (obs/quality.py) can see it — exactly the blind spot the
+    # quality drift verdict exists to close.
+    replica_degrade_at: tuple[int, ...] = ()
     replica_fault_after: int = 8
     # host-level acting sites (elastic training chaos, train/elastic.py
     # maybe_host_fault): the site index is the TRAINER HOST index
@@ -116,7 +124,7 @@ class FaultConfig:
 
 _SITES = ("decode", "assemble", "fetch", "ckpt_save", "ckpt_restore",
           "dispatch", "ckpt_truncate", "ckpt_corrupt",
-          "replica_crash", "replica_wedge",
+          "replica_crash", "replica_wedge", "replica_degrade",
           "host_loss", "host_wedge", "preempt_notice")
 
 

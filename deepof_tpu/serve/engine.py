@@ -85,6 +85,8 @@ from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
 from ..obs.export import (LatencyHistogram, percentile_ms, slo_state,
                           validate_slo)
+from ..obs.quality import (QualityScorer, make_score_fn, quality_avals,
+                           score_pair_np)
 from .buckets import (flow_to_native, pick_bucket, prepare_frame,
                       prepare_pair, resolve_buckets)
 from .quant import dequantize_params, quantize_params, resolve_precisions
@@ -123,7 +125,7 @@ class ServeError(RuntimeError):
 class _Request:
     __slots__ = ("x", "bucket", "tier", "native_hw", "future", "t_enq",
                  "rid", "session", "frame_index", "mode", "prior",
-                 "session_epoch")
+                 "session_epoch", "score")
 
     def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid,
                  session=None, frame_index=None, mode="cold", prior=None,
@@ -148,6 +150,10 @@ class _Request:
         # the session's prime-generation at advance() time: the
         # writeback token set_flow guards on (None off-session)
         self.session_epoch = session_epoch
+        # label-free quality sampling (obs/quality.py): set at enqueue
+        # by the deterministic sampler; a sampled request's (input,
+        # raw flow) pair is handed to the off-path scorer at resolve
+        self.score = False
 
     @property
     def key(self) -> tuple[tuple[int, int], str, str]:
@@ -470,6 +476,36 @@ class InferenceEngine:
         # the latency deque, this can't clamp the rate at high load
         self._done_per_s: dict[int, int] = {}
 
+        # label-free flow-quality scoring (obs/quality.py): OFF by
+        # default (sample_rate 0 constructs nothing — the serve path
+        # stays bitwise- and schema-unchanged). Real-model engines score
+        # through one jitted executable per bucket (pre-lowered by
+        # `warmup --serve`); custom/fake executors score through the
+        # numpy reference — jax-free fleet replicas keep quality eyes.
+        self._quality: QualityScorer | None = None
+        self._quality_index = 0  # deterministic sampler's request index
+        self._score_compiled: dict[tuple[int, int], object] = {}
+        obs = cfg.obs
+        if float(obs.quality_sample_rate) > 0:
+            if self._forward_custom:
+                score_fn = (lambda bucket, x, flow:
+                            score_pair_np(x[0], flow[0]))
+            else:
+                import jax
+
+                self._score_jit = jax.jit(make_score_fn())
+                score_fn = (lambda bucket, x, flow:
+                            tuple(float(v) for v in np.asarray(
+                                self._score_executable(bucket)(x, flow))))
+            self._quality = QualityScorer(
+                score_fn, obs.quality_sample_rate,
+                seed=obs.quality_seed,
+                queue_depth=obs.quality_queue_depth,
+                ref_samples=obs.quality_ref_samples,
+                window=obs.quality_window,
+                drift_factor=obs.quality_drift_factor,
+                budget=obs.quality_budget)
+
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serve-batcher")
         self._thread.start()
@@ -667,6 +703,13 @@ class InferenceEngine:
                 raise ServeError("engine_closed", "engine is shut down",
                                  req.rid)
             self._submitting += 1
+            if self._quality is not None:
+                # the sampling decision is a pure function of the
+                # accepted-request index (obs/quality.py): the sampled
+                # SET depends only on submission order — never on
+                # batching, scorer backlog, or decode-worker count
+                req.score = self._quality.should_sample(self._quality_index)
+                self._quality_index += 1
         try:
             # bounded put = backpressure, but polled: a submitter blocked
             # on a full queue must observe close() instead of completing
@@ -810,6 +853,15 @@ class InferenceEngine:
                         r.session,
                         np.ascontiguousarray(out[i], np.float32), bucket,
                         r.session_epoch)
+                if r.score and self._quality is not None:
+                    # sampled label-free quality scoring (obs/quality.py):
+                    # hand (input row, RAW dispatch output) to the
+                    # off-path scorer. Row copies detach from the flush's
+                    # output buffer; a full scorer queue drops-and-counts
+                    # inside submit() — this response is never delayed.
+                    self._quality.submit(
+                        r.x, np.array(out[i], np.float32, copy=True),
+                        bucket, r.tier, r.mode)
                 done = time.monotonic()
                 self._hist.observe(done - r.t_enq)
                 if r.session is not None:
@@ -903,13 +955,32 @@ class InferenceEngine:
                 self._compiled[key] = c
         return c
 
+    def _score_executable(self, bucket: tuple[int, int]):
+        """The bucket's AOT-compiled quality scorer (obs/quality.py) —
+        ONE executable per bucket (tiers and modes share it: the scorer
+        consumes f32 inputs and f32 flow regardless of the tier that
+        produced them), compiled (or loaded from the persistent cache —
+        the `warmup --serve` contract) on first use."""
+        with self._compile_lock:
+            c = self._score_compiled.get(bucket)
+            if c is None:
+                flow_hw = cold_output_hw(
+                    self._jit, self._params_by_tier[self.default_tier],
+                    bucket, self.max_batch)
+                x_sds, flow_sds = quality_avals(bucket, flow_hw)
+                c = self._score_jit.lower(x_sds, flow_sds).compile()
+                self._score_compiled[bucket] = c
+        return c
+
     def warm(self) -> dict:
         """AOT-compile every configured (bucket, tier, mode) triple now
         (server startup / offline-mode entry), through the persistent
         compile cache when active — after `warmup --serve` these are
         loads, not compiles. The mode axis exists only under
-        serve.session.warm_start. Returns per-entry timings + the cache
-        hit/miss delta."""
+        serve.session.warm_start; quality-scorer executables
+        (obs.quality_sample_rate > 0) ride along, one per bucket, so
+        sampling never compiles on the hot path. Returns per-entry
+        timings + the cache hit/miss delta."""
         # the postprocess import chain (train/evaluate and friends) is
         # first-request latency too — ~seconds in a fresh process, paid
         # inside the batcher thread if not paid here (measured via
@@ -932,6 +1003,12 @@ class InferenceEngine:
                             {"bucket": list(b), "tier": tier, "mode": mode,
                              "compile_s": round(
                                  time.perf_counter() - t0, 3)})
+                if self._quality is not None:
+                    t0 = time.perf_counter()
+                    self._score_executable(b)
+                    out["buckets"].append(
+                        {"bucket": list(b), "tier": "-", "mode": "quality",
+                         "compile_s": round(time.perf_counter() - t0, 3)})
         out["cache"] = d.stats()
         return out
 
@@ -995,6 +1072,11 @@ class InferenceEngine:
         out["serve_session_latency_hist"] = shist
         out["serve_session_latency_p50_ms"] = percentile_ms(shist, 0.50)
         out["serve_session_latency_p99_ms"] = percentile_ms(shist, 0.99)
+        # label-free quality block (obs/quality.py): present ONLY when
+        # sampling is configured on — sample_rate 0 keeps the serve
+        # schema byte-identical to the pre-quality stack
+        if self._quality is not None:
+            out.update(self._quality.stats())
         # fixed-bucket histogram + SLO state (obs/export.py): the
         # scrapeable /metrics face; replica histograms merge exactly at
         # the router because the buckets are fixed by contract
@@ -1027,6 +1109,13 @@ class InferenceEngine:
         # consuming at this point).
         self._q.put(_STOP)
         self._thread.join(timeout=60.0)
+        if self._quality is not None:
+            # AFTER the batcher join: drained flushes still submit
+            # samples, and the scorer's exit sentinel must queue behind
+            # them — the shutdown stats record (server.py's final serve
+            # record) sees every tail-of-run sample scored, not
+            # abandoned mid-queue.
+            self._quality.close()  # stop the quality scorer thread
         # submitters that passed the closed check before we flipped it
         # may still complete a put; wait them out, then fail any request
         # the (now dead) batcher will never see

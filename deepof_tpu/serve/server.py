@@ -100,6 +100,11 @@ def install_replica_faults(engine: InferenceEngine,
         return
     idx = replica_index()
     after = max(int(cfg.resilience.faults.replica_fault_after), 0)
+    # replica_degrade is a PERSISTENT condition, not a one-shot event:
+    # once armed, every later dispatch returns corrupted flow — silently
+    # damaged weights as a steady state. Scheduled-ness is read once
+    # (pure in config); the consume-once hit() only counts the arming.
+    degrade = inj.scheduled("replica_degrade", idx)
     inner = engine._forward
 
     def forward(key, x, *args, **kw):
@@ -112,7 +117,14 @@ def install_replica_faults(engine: InferenceEngine,
                 os.kill(os.getpid(), signal.SIGKILL)
             if inj.hit("replica_wedge", idx):
                 threading.Event().wait()  # never returns: wedged dispatch
-        return inner(key, x, *args, **kw)
+        out = inner(key, x, *args, **kw)
+        if degrade and done >= after:
+            inj.hit("replica_degrade", idx)  # count the arming, once
+            # a large constant flow offset: latency/SLO axes stay
+            # perfectly healthy, only the label-free quality proxies
+            # (obs/quality.py) can see it — the drift-verdict target
+            out = np.asarray(out) + np.float32(25.0)
+        return out
 
     engine._forward = forward
 

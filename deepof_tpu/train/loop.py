@@ -67,6 +67,29 @@ _EARLY_SIGTERM: dict = {"sig": None, "handler": None}
 # device had no staged batch to eat); below it is queue-handoff noise.
 STARVED_WAIT_S = 1e-3
 
+#: Per-pyramid-scale loss decomposition: record field -> the step
+#: metrics key it reads (train/step.py stacks these per scale, finest
+#: first). "Models Matter, So Does Training" (PAPERS.md): the per-scale
+#: photometric-vs-smoothness trajectories are what predicts EPE — and
+#: the signal ROADMAP item 3's EPE-driven curriculum switch points will
+#: consume. Written into every periodic train record by _on_metrics.
+SCALE_RECORD_FIELDS: tuple[tuple[str, str], ...] = (
+    ("loss_total_by_scale", "scale_total"),
+    ("loss_photo_by_scale", "scale_Charbonnier_reconstruct"),
+    ("loss_smooth_by_scale", "scale_smooth"),
+)
+
+
+def per_scale_last(v) -> list[float]:
+    """Last inner step's per-scale vector (finest first) as a JSON-ready
+    list — the loss_*_by_scale record fields. Arrays carry a leading K
+    axis when steps_per_call > 1; 6 significant figures keep the record
+    compact without rounding a 1e-5-scale term to zero."""
+    a = np.asarray(v)
+    if a.ndim == 2:  # [K, S] under steps_per_call stacking
+        a = a[-1]
+    return [float(f"{float(x):.6g}") for x in np.atleast_1d(a)]
+
 
 def _poison_batch(batch: dict) -> dict:
     """Dispatch-site fault action: one NaN in the first float input
@@ -694,6 +717,12 @@ class Trainer:
                         grad_norm=_scalar_last(m_host["grad_norm"]),
                         **{key: _scalar_last(v) for key, v in m_host.items()
                            if key in ("action_loss", "accuracy")},
+                        # per-pyramid-scale loss decomposition (finest
+                        # first): photometric vs smoothness trajectories
+                        # in every periodic record, not just the total
+                        **{field: per_scale_last(m_host[src])
+                           for field, src in SCALE_RECORD_FIELDS
+                           if src in m_host},
                         **timer.rates(), **timer.phases(),
                         **timer.counters(), **resilience_stats(),
                         **cache_kw, **self._telemetry(timer))

@@ -190,7 +190,12 @@ def make_train_step(model, cfg: ExperimentConfig, mean: Mean, mesh,
         metrics = {"total": total, "grad_norm": grad_norm,
                    "update_skipped": skipped}
         if "losses" in aux:
-            for key in ("total", "Charbonnier_reconstruct", "U_loss", "V_loss"):
+            # per-pyramid-scale decomposition (finest first): photometric
+            # ("Charbonnier_reconstruct") and smoothness ("smooth" = U+V)
+            # components ride every metrics fetch — the loop folds them
+            # into each periodic train record as loss_*_by_scale lists
+            for key in ("total", "Charbonnier_reconstruct", "U_loss",
+                        "V_loss", "smooth"):
                 metrics[f"scale_{key}"] = jnp.stack([d[key] for d in aux["losses"]])
         for key in ("action_loss", "accuracy"):
             if key in aux:

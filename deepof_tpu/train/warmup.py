@@ -270,7 +270,10 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     tests/test_warm.py). The mode axis ({cold} or {cold, warm}) follows
     `serve.session.warm_start`: a warm-enabled config's FIRST warm step
     — the temporal warm-start refinement executable — is pre-lowered
-    next to its cold siblings.
+    next to its cold siblings. When `obs.quality_sample_rate` > 0 the
+    per-bucket label-free quality-scorer executables (obs/quality.py)
+    are pre-lowered too, so sampled scoring on a cold endpoint loads
+    instead of compiling.
 
     No checkpoint needed: params enter as ShapeDtypeStructs from an
     eval_shape of model.init — warmup compiles executables for a
@@ -289,6 +292,7 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     """
     import jax.numpy as jnp
 
+    from ..obs.quality import make_score_fn, quality_avals
     from ..serve.buckets import resolve_buckets
     from ..serve.engine import (PAIR_CHANNELS, build_refine_model,
                                 build_serve_model, cold_output_hw,
@@ -308,6 +312,12 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     if "warm" in modes:
         refine_model = build_refine_model(cfg)
         refine_fwd = jax.jit(make_refine_forward(refine_model))
+    # quality-scorer executables (obs/quality.py) ride the same warmup:
+    # one per bucket (tiers/modes share it — f32 in, f32 flow in), same
+    # make_score_fn + quality_avals lowering the engine uses at runtime,
+    # so a sampled request on a cold endpoint LOADS its scorer
+    score_jit = (jax.jit(make_score_fn())
+                 if float(cfg.obs.quality_sample_rate) > 0 else None)
 
     out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
                            "backend": jax.default_backend(),
@@ -398,6 +408,29 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                          "status": ("hit" if bd["hits"] >= 1
                                     else "persisted" if wrote
                                     else "skipped")})
+            if score_jit is not None:
+                # the bucket's quality scorer: flow grid derived from
+                # the DEFAULT tier's cold executable, exactly as
+                # engine._score_executable derives it at runtime
+                tier0_sds = jax.eval_shape(
+                    lambda p: quantize_params(p, tiers[0]),
+                    variables_sds["params"])
+                before_files = _entries()
+                bucket_delta = cache_delta()
+                t0 = time.perf_counter()
+                flow_hw = cold_output_hw(fwd, tier0_sds, bucket, max_batch)
+                x_sds, flow_sds = quality_avals(bucket, flow_hw)
+                score_jit.lower(x_sds, flow_sds).compile()
+                bd = bucket_delta.stats()
+                wrote = bool(_entries() - before_files)
+                persisted = wrote or bd["hits"] >= 1
+                out["buckets"].append(
+                    {"bucket": [h, w], "tier": "-", "mode": "quality",
+                     "compile_s": round(time.perf_counter() - t0, 3),
+                     "persisted": persisted,
+                     "status": ("hit" if bd["hits"] >= 1
+                                else "persisted" if wrote
+                                else "skipped")})
     out["cache"] = d.stats()
     out["persisted_buckets"] = sum(b["persisted"] for b in out["buckets"])
     out["skipped_buckets"] = sum(not b["persisted"] for b in out["buckets"])

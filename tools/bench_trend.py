@@ -1,0 +1,165 @@
+"""Perf-trajectory trend report over every BENCH_r*.json in the repo.
+
+The ROADMAP's never-go-dark rule has every PR since r06 recording the
+cpu proxies (data_bench, serve_bench, fleet, precision, stream/warm,
+lint wall-time, quality) into one BENCH_r<NN>.json per round — but
+reading the trajectory meant opening 13 files by hand. This tool folds
+them into ONE report: per-proxy series over rounds, the best-so-far
+value per proxy, and a regression flag when the newest round sits more
+than ``--tolerance`` below the best — the "did this PR cost us a proxy"
+question as one JSON line.
+
+Proxy extraction is a declarative spec table (name, JSON path, higher-
+or-lower-is-better); rounds that predate a proxy simply lack points in
+its series (r01–r04 used the old bench-orchestrate schema and carry no
+extractable proxies — they still count as rounds). All host-noise
+caveats from the per-round notes apply: these are CONTENDED-HOST cpu
+proxies, so the regression flag is a prompt to read the round's note,
+not a verdict by itself.
+
+Run: python tools/bench_trend.py [--dir /root/repo] [--tolerance 0.3]
+     [--json-indent 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+#: keys every bench_trend report carries (schema smoke test)
+REQUIRED_KEYS = (
+    "rounds", "latest_round", "files", "series", "best", "latest",
+    "regressions", "tolerance",
+)
+
+#: (series name, path through the BENCH json, "higher"|"lower" = better).
+#: Series names deliberately avoid the registry-linted counter prefixes:
+#: these are report fields, not stats-block keys.
+PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
+    ("bench_data_w0_batches_per_s", ("data_bench", "workers0", "value"),
+     "higher"),
+    ("bench_serve_requests_per_s", ("serve_bench", "value"), "higher"),
+    ("bench_serve_speedup_vs_serial", ("serve_bench", "speedup_vs_serial"),
+     "higher"),
+    ("bench_fleet_requests_per_s", ("serve_bench_fleet", "value"),
+     "higher"),
+    ("bench_fleet_speedup_vs_single",
+     ("serve_bench_fleet", "speedup_vs_single"), "higher"),
+    ("bench_precision_int8_requests_per_s",
+     ("serve_bench_precision", "tiers", "int8", "requests_per_s"),
+     "higher"),
+    ("bench_precision_int8_epe_vs_f32",
+     ("serve_bench_precision", "tiers", "int8", "epe_vs_f32"), "lower"),
+    ("bench_stream_speedup", ("serve_bench_stream", "value"), "higher"),
+    ("bench_warm_speedup", ("serve_bench_stream", "warm", "value"),
+     "higher"),
+    ("bench_warm_epe_vs_cold_px",
+     ("serve_bench_stream", "warm", "epe_vs_cold_px"), "lower"),
+    ("bench_lint_wall_s", ("lint", "value"), "lower"),
+    ("bench_elastic_recovery_s",
+     ("elastic_drill", "host_loss", "recovery_wall_s"), "lower"),
+    ("bench_quality_scorer_overhead_pct",
+     ("serve_bench_quality", "scorer_overhead_pct"), "lower"),
+    ("bench_quality_photo_f32", ("serve_bench_quality", "tiers", "f32",
+                                 "photo"), "lower"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _lookup(d, path: tuple[str, ...]):
+    for key in path:
+        if not isinstance(d, dict) or key not in d:
+            return None
+        d = d[key]
+    return d if isinstance(d, (int, float)) and not isinstance(d, bool) \
+        else None
+
+
+def bench_trend(bench_dir: str, tolerance: float = 0.3) -> dict:
+    """The trend report (see module docstring). tolerance: relative
+    slack before the latest point of a series flags as a regression
+    against its best-so-far (0.3 = flag when >30% worse — wide on
+    purpose: these proxies run on contended hosts)."""
+    # filter by the round regex, not just the glob: a stray
+    # BENCH_rerun.json / BENCH_r13-old.json in the repo root is skipped,
+    # not a crash in the sort key
+    files = sorted((p for p in glob.glob(os.path.join(bench_dir,
+                                                      "BENCH_r*.json"))
+                    if _ROUND_RE.search(p)),
+                   key=lambda p: int(_ROUND_RE.search(p).group(1)))
+    rounds: list[int] = []
+    series: dict[str, list[dict]] = {name: [] for name, _, _ in PROXY_SPEC}
+    for path in files:
+        m = _ROUND_RE.search(path)
+        rnd = int(m.group(1))
+        rounds.append(rnd)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue  # a torn/absent round stays a round, with no points
+        for name, spec, _ in PROXY_SPEC:
+            value = _lookup(data, spec)
+            if value is not None:
+                series[name].append({"round": rnd, "value": value})
+
+    best: dict[str, dict] = {}
+    latest: dict[str, dict] = {}
+    regressions: dict[str, dict] = {}
+    for name, _, sense in PROXY_SPEC:
+        pts = series[name]
+        if not pts:
+            continue
+        pick = max if sense == "higher" else min
+        b = pick(pts, key=lambda p: p["value"])
+        last = pts[-1]
+        best[name] = {"round": b["round"], "value": b["value"],
+                      "sense": sense}
+        latest[name] = {"round": last["round"], "value": last["value"]}
+        bv, lv = float(b["value"]), float(last["value"])
+        if bv == 0:
+            continue
+        worse = ((bv - lv) / abs(bv) if sense == "higher"
+                 else (lv - bv) / abs(bv))
+        if worse > float(tolerance):
+            regressions[name] = {
+                "best_round": b["round"], "best": b["value"],
+                "latest_round": last["round"], "latest": last["value"],
+                "worse_frac": round(worse, 4),
+            }
+    return {
+        "rounds": rounds,
+        "latest_round": rounds[-1] if rounds else None,
+        "files": [os.path.basename(p) for p in files],
+        "series": {k: v for k, v in series.items() if v},
+        "best": best,
+        "latest": latest,
+        "regressions": regressions,
+        "tolerance": float(tolerance),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_trend")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json files (default: repo "
+             "root)")
+    ap.add_argument("--tolerance", type=float, default=0.3,
+                    help="relative slack vs best-so-far before the "
+                         "latest round flags as a regression (default "
+                         "0.3 — wide: contended-host proxies)")
+    ap.add_argument("--json-indent", type=int, default=None)
+    args = ap.parse_args(argv)
+    report = bench_trend(args.dir, tolerance=args.tolerance)
+    print(json.dumps(report, indent=args.json_indent))
+    # regressions are a prompt to read the round note, not a failure:
+    # rc stays 0 so CI trend collection never blocks on host noise
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

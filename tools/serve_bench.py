@@ -118,6 +118,19 @@ TIER_REQUIRED_KEYS = (
     "errors", "wall_s", "weight_bytes",
 )
 
+#: keys every --quality result carries at the top level (schema smoke
+#: test): per-tier label-free proxy scores on the standard seeded pairs
+#: plus the scorer-overhead pair the ISSUE 13 acceptance reads
+#: (sample_rate 0.1 p99 must degrade < 5% vs off)
+QUALITY_REQUIRED_KEYS = (
+    "mode", "requests", "max_batch", "timeout_ms", "gap_ms", "bucket",
+    "precisions", "tiers", "quality", "sample_rate",
+    "rps_quality_off", "rps_quality_on", "scorer_overhead_pct",
+    "p99_quality_off_ms", "p99_quality_on_ms", "p99_overhead_pct",
+)
+#: ... and per tier inside result["tiers"][<tier>]
+QUALITY_TIER_REQUIRED_KEYS = ("photo", "smooth", "census", "scored")
+
 
 def _bench_cfg(bucket: tuple[int, int], max_batch: int, timeout_ms: float,
                log_dir: str | None):
@@ -534,6 +547,99 @@ def precision_bench(requests: int = 24, gap_ms: float = 0.5,
     return out
 
 
+# ----------------------------------------------------------- quality
+
+
+def quality_bench(requests: int = 24, gap_ms: float = 0.5,
+                  max_batch: int = 4, timeout_ms: float = 5.0,
+                  bucket: tuple[int, int] = (32, 64),
+                  native_hw: tuple[int, int] = (30, 60),
+                  tiers: tuple[str, ...] = ("f32", "bf16", "int8"),
+                  sample_rate: float = 0.1,
+                  log_dir: str | None = None) -> dict:
+    """Label-free quality-proxy block (obs/quality.py) on the standard
+    seeded pairs, two phases through the REAL model forward:
+
+      scores  one engine at sample_rate 1.0 runs the identical seeded
+              workload per precision tier and reports the mean photo /
+              smooth / census proxies per tier (from the per-key sum
+              maps — the same numbers a fleet merge would re-derive),
+              plus the drift-verdict block after the whole sweep.
+      overhead  two fresh engines (quality off vs sample_rate
+              `sample_rate`) run the f32 workload; the requests/s and
+              p99 deltas are the scorer's hot-path cost — the ISSUE 13
+              acceptance wants p99 degradation < 5% at 0.1.
+    """
+    import dataclasses as dc
+
+    tiers = ("f32",) + tuple(t for t in tiers if t != "f32")
+    cfg = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    cfg = cfg.replace(serve=dc.replace(cfg.serve, precisions=tiers))
+    model_params = (_real_model_params(cfg) if not log_dir else None)
+
+    rng = np.random.RandomState(0)
+    pairs = [(rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8),
+              rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8))
+             for _ in range(max(int(requests), 1))]
+
+    def q_cfg(rate: float):
+        return cfg.replace(obs=dc.replace(cfg.obs,
+                                          quality_sample_rate=rate))
+
+    out = {"mode": "quality", "requests": len(pairs),
+           "max_batch": max_batch, "timeout_ms": timeout_ms,
+           "gap_ms": gap_ms, "bucket": list(bucket),
+           "precisions": list(tiers), "sample_rate": sample_rate,
+           "tiers": {}}
+    # phase 1: per-tier proxy scores at sample_rate 1.0
+    with InferenceEngine(q_cfg(1.0), model_params=model_params) as engine:
+        engine.warm()
+        for tier in tiers:
+            run_workload(engine, pairs, gap_ms, precision=tier)
+        engine._quality.drain(120.0)
+        stats = engine.stats()
+        scored = stats["serve_quality_scored_by_key"]
+        sums = {"photo": stats["serve_quality_photo_sum_by_key"],
+                "smooth": stats["serve_quality_smooth_sum_by_key"],
+                "census": stats["serve_quality_census_sum_by_key"]}
+        for tier in tiers:
+            key = f"{tier}/cold"
+            n = scored.get(key, 0)
+            out["tiers"][tier] = {
+                "scored": n,
+                **{proxy: (round(sums[proxy].get(key, 0.0) / n, 6)
+                           if n else None)
+                   for proxy in ("photo", "smooth", "census")},
+            }
+        out["quality"] = stats["serve_quality"]
+        out["dropped"] = stats["serve_quality_dropped"]
+    # phase 2: scorer overhead — identical f32 workload, quality off vs
+    # sampled at `sample_rate` (fresh engines: no warm-cache crosstalk)
+    def timed(rate: float):
+        with InferenceEngine(q_cfg(rate),
+                             model_params=model_params) as eng:
+            eng.warm()
+            wall, errors, results = run_workload(eng, pairs, gap_ms)
+            lats = [r["latency_s"] for r in results if r is not None]
+            if eng._quality is not None:
+                eng._quality.drain(120.0)
+        rps = (len(pairs) - errors) / wall if wall > 0 else None
+        return rps, _percentile_ms(lats, 0.99)
+
+    rps_off, p99_off = timed(0.0)
+    rps_on, p99_on = timed(float(sample_rate))
+    out["rps_quality_off"] = round(rps_off, 2) if rps_off else None
+    out["rps_quality_on"] = round(rps_on, 2) if rps_on else None
+    out["scorer_overhead_pct"] = (
+        round(100.0 * (rps_off - rps_on) / rps_off, 2)
+        if rps_off and rps_on else None)
+    out["p99_quality_off_ms"] = p99_off
+    out["p99_quality_on_ms"] = p99_on
+    out["p99_overhead_pct"] = (round(100.0 * (p99_on - p99_off) / p99_off, 2)
+                               if p99_off and p99_on else None)
+    return out
+
+
 # ------------------------------------------------------------- fleet
 
 
@@ -773,6 +879,17 @@ def main(argv=None) -> int:
                          "list; bare flag = f32,bf16,int8) on the real "
                          "model: per-tier requests/s, p50/p99, weight "
                          "bytes, and epe_vs_f32 on seeded pairs")
+    ap.add_argument("--quality", action="store_true",
+                    help="label-free quality-proxy block (obs/quality.py)"
+                         " on the real model: per-tier photo/smooth/"
+                         "census proxy scores on the standard seeded "
+                         "pairs, the drift-verdict block, and the "
+                         "scorer's hot-path overhead (requests/s + p99, "
+                         "quality off vs --quality-rate)")
+    ap.add_argument("--quality-rate", type=float, default=0.1,
+                    help="quality mode: sample rate of the overhead "
+                         "measurement (the scores phase always samples "
+                         "at 1.0)")
     args = ap.parse_args(argv)
 
     def hw(spec):
@@ -795,6 +912,12 @@ def main(argv=None) -> int:
                            warm_frames=args.warm_frames,
                            warm_width=args.warm_width,
                            log_dir=args.log_dir)
+    elif args.quality:
+        res = quality_bench(
+            requests=args.requests, gap_ms=args.gap_ms,
+            max_batch=args.max_batch, timeout_ms=args.timeout_ms,
+            bucket=hw(args.bucket), native_hw=hw(args.native),
+            sample_rate=args.quality_rate, log_dir=args.log_dir)
     elif args.precision is not None:
         res = precision_bench(
             requests=args.requests, gap_ms=args.gap_ms,
