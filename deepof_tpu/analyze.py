@@ -179,6 +179,20 @@ def eval_trend(evals: list[dict], window: int = 8,
     }
 
 
+def _scale_event_summary(scales: list[dict]) -> dict:
+    """Condensed view of the autoscaler's kind="fleet" scale records:
+    how many times the pool moved, which way, and the newest event."""
+    last = scales[-1]
+    return {
+        "events": len(scales),
+        "ups": sum(1 for r in scales if r.get("event") == "scale_up"),
+        "downs": sum(1 for r in scales if r.get("event") == "scale_down"),
+        "last": {k: last.get(k) for k in
+                 ("event", "reason", "replica", "replicas_before",
+                  "replicas_after", "time") if last.get(k) is not None},
+    }
+
+
 def summarize(records: list[dict]) -> dict:
     by_kind: dict[str, list[dict]] = defaultdict(list)
     for r in records:
@@ -247,6 +261,13 @@ def summarize(records: list[dict]) -> dict:
         fleet = _fleet_counters(serves[-1])
         if fleet:
             out["fleet"] = fleet
+
+    scales = by_kind.get("fleet", [])
+    if scales:
+        # the autoscaler's pool-size timeline (serve/autoscale.py
+        # appends one kind="fleet" record per scale event): the event
+        # count plus the newest event's what/why/when
+        out["scale_events"] = _scale_event_summary(scales)
 
     elastics = by_kind.get("elastic", [])
     if elastics:
@@ -479,6 +500,12 @@ def tail_summary(log_dir: str, recent: int = 10,
             fleet_block = _fleet_counters(serves[-1])
             if fleet_block:
                 out["fleet"] = fleet_block
+    scales = [r for r in records if r.get("kind") == "fleet"]
+    if scales:
+        # autoscale pool-size timeline (one kind="fleet" record per
+        # scale event) — the live fleet block above already carries the
+        # fleet_autoscale_* counters; this names the newest move
+        out["scale_events"] = _scale_event_summary(scales)
     if "elastic" not in out:
         elastics = [r for r in records if r.get("kind") == "elastic"]
         if elastics:

@@ -81,13 +81,18 @@ def _build_cfg(args) -> ExperimentConfig:
             gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
             train=dataclasses.replace(cfg.train, eval_batch_size=8,
                                       eval_amplifier=1.0))
-    # serve session sugar: the flags ride the same nested-override path
-    # as --set (and before it, so an explicit --set still wins)
+    # serve session/autoscale sugar: the flags ride the same
+    # nested-override path as --set (and before it, so an explicit
+    # --set still wins)
     for flag, dotted in (("session_ttl", "serve.session.ttl_s"),
-                        ("session_max", "serve.session.max_sessions")):
+                        ("session_max", "serve.session.max_sessions"),
+                        ("min_replicas", "serve.fleet.min_replicas"),
+                        ("max_replicas", "serve.fleet.max_replicas")):
         value = getattr(args, flag, None)
         if value is not None:
             cfg = _apply_override(cfg, dotted, repr(value))
+    if getattr(args, "autoscale", False):
+        cfg = _apply_override(cfg, "serve.fleet.autoscale", "true")
     for item in args.set or []:
         if "=" not in item:
             raise SystemExit(f"bad --set {item!r}: use section.field=value")
@@ -233,6 +238,24 @@ def main(argv=None) -> int:
                             "evict/respawn of wedged or crashed "
                             "replicas. Overrides serve.fleet.replicas; "
                             "<= 1 keeps single-process serving")
+    p_srv.add_argument("--autoscale", action="store_true",
+                       help="SLO-driven fleet autoscaling (DESIGN.md "
+                            "\"Supervision plane\"): scale the replica "
+                            "pool between serve.fleet.min_replicas and "
+                            "max_replicas from live signals — sustained "
+                            "shed/overload and SLO budget burn scale up, "
+                            "sustained idle scales down via graceful "
+                            "drain. Shorthand for "
+                            "--set serve.fleet.autoscale=true; implies "
+                            "fleet mode even without --replicas")
+    p_srv.add_argument("--min-replicas", type=int, default=None,
+                       metavar="N",
+                       help="autoscaler pool floor — shorthand for "
+                            "--set serve.fleet.min_replicas=N")
+    p_srv.add_argument("--max-replicas", type=int, default=None,
+                       metavar="N",
+                       help="autoscaler pool ceiling — shorthand for "
+                            "--set serve.fleet.max_replicas=N")
     p_srv.add_argument("--config-json", default=None,
                        help=argparse.SUPPRESS)  # fleet-internal: replica
     #                      processes load the supervisor's exact config
@@ -392,7 +415,10 @@ def main(argv=None) -> int:
             # rc 4 when a serving fleet self-healed (evictions) or gave
             # up on a replica (circuit breaker): the fleet may be
             # serving again, but an operator must see that replicas
-            # were sick — the counters are cumulative by design
+            # were sick — the counters are cumulative by design.
+            # Autoscale scale-downs deliberately do NOT trip this:
+            # retirement (fleet_retired / autoscale_down) is the pool
+            # doing its job, not sickness
             fleet = summary.get("fleet") or {}
             if fleet.get("broken") or fleet.get("evictions"):
                 return 4
@@ -564,17 +590,19 @@ def main(argv=None) -> int:
         replicas = (args.replicas if args.replicas is not None
                     else cfg.serve.fleet.replicas)
         if args.input is not None:
-            if replicas and replicas > 1:
-                raise SystemExit("serve: --replicas is HTTP-fleet only "
-                                 "(offline mode already parallelizes via "
-                                 "serve.workers)")
+            if (replicas and replicas > 1) or cfg.serve.fleet.autoscale:
+                raise SystemExit("serve: --replicas/--autoscale are "
+                                 "HTTP-fleet only (offline mode already "
+                                 "parallelizes via serve.workers)")
             from .serve.server import run_offline
 
             res = run_offline(cfg, args.input, args.out,
                               write_png=not args.no_png)
             print(json.dumps(res))
             return 0
-        if replicas and replicas > 1:
+        if (replicas and replicas > 1) or cfg.serve.fleet.autoscale:
+            # autoscale implies fleet mode even at --replicas 1: the
+            # pool needs the supervisor/router to grow from its floor
             from .serve.fleet import run_fleet
 
             return run_fleet(cfg, replicas)

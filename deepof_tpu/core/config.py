@@ -387,6 +387,46 @@ class FleetConfig:
     # graceful shutdown: stop admission, wait this long for in-flight
     # requests to flush before reaping replicas
     drain_timeout_s: float = 10.0
+    # --- SLO-driven autoscaler (serve/autoscale.py, DESIGN.md
+    # "Supervision plane"): the fixed `--replicas N` pool becomes a
+    # load-follower between min_replicas and max_replicas, scaling up on
+    # sustained shed/overload, SLO breach burn, or near-saturation
+    # occupancy, and down on sustained idle — always via graceful drain
+    # (retire, never evict: `tail`'s rc-4 contract stays about
+    # sickness). Hysteresis lives in the threshold gap (up_occupancy >>
+    # down_occupancy) + the sustain windows; the cooldowns keep the
+    # respawn-compile cost of a fresh replica from flapping the pool.
+    autoscale: bool = False
+    # pool bounds: the autoscaler owns the size between these
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # control-loop evaluation cadence
+    autoscale_period_s: float = 1.0
+    # scale up only after pressure (shed/overload delta, SLO breach
+    # burn, occupancy >= up threshold) persists this long
+    autoscale_up_after_s: float = 2.0
+    # scale down only after idleness (occupancy <= down threshold AND
+    # zero shed) persists this long — much longer than the up window:
+    # adding capacity late sheds traffic, removing it late wastes a
+    # replica
+    autoscale_down_after_s: float = 20.0
+    # pool occupancy (router in-flight / (ready * max_in_flight)) at or
+    # above which a tick counts as pressure
+    autoscale_up_occupancy: float = 0.75
+    # occupancy at or below which a tick counts as idle; the wide gap
+    # to up_occupancy is the hysteresis band where the pool holds steady
+    autoscale_down_occupancy: float = 0.15
+    # SLO budget-burn fraction (obs.slo_latency_ms must be set) at or
+    # above which NEW latency breaches count as pressure — capacity is
+    # added while the budget still has headroom, not after exhaustion
+    autoscale_up_slo_burn: float = 0.5
+    # no second scale-up within this window of the previous one: a
+    # burst must not spawn the whole ladder before the first new
+    # replica has even compiled
+    autoscale_up_cooldown_s: float = 5.0
+    # no scale-down within this window of ANY scale event: a fresh
+    # replica's warm-up idle must not immediately retire its sibling
+    autoscale_down_cooldown_s: float = 30.0
 
 
 @dataclass(frozen=True)

@@ -152,6 +152,10 @@ _ENTRIES: list[Key] = [
            "fleet_session_lost", "fleet_session_evicted",
            "fleet_session_expired"),
     *_keys("router", "gauge", "fleet_in_flight", "fleet_sessions_sticky"),
+    # routed counts folded out of the per-index map when a slot retires
+    # (autoscale scale-down): keeps fleet_routed bounded by the active
+    # pool while the total stays monotonic
+    Key("fleet_routed_retired", "sum", "router"),
     Key("fleet_routed", "map", "router"),
     Key("fleet_draining", "bool", "router"),
     Key("fleet_latency_hist", "hist", "router"),
@@ -163,7 +167,22 @@ _ENTRIES: list[Key] = [
            "fleet_evictions", "fleet_crashes", "fleet_clean_exits",
            "fleet_wedge_evictions", "fleet_stale_evictions",
            "fleet_spawn_failures", "fleet_respawns", "fleet_broken",
-           "fleet_kill_escalations"),
+           "fleet_kill_escalations",
+           # graceful scale-down departures (autoscaler): deliberately
+           # NOT an eviction — `tail`'s rc-4 contract stays about
+           # sickness, retirement is the pool doing its job
+           "fleet_retired"),
+    # ---------------------- fleet_autoscale_* (serve/autoscale.py):
+    # the SLO-driven load-follower's own block — scale events, streak
+    # ticks, and the pool bounds it scales between
+    Key("fleet_autoscale_enabled", "bool", "fleet"),
+    *_keys("fleet", "gauge",
+           "fleet_autoscale_min", "fleet_autoscale_max",
+           "fleet_autoscale_last_event_s"),
+    *_keys("fleet", "sum",
+           "fleet_autoscale_up", "fleet_autoscale_down",
+           "fleet_autoscale_blocked_max",
+           "fleet_autoscale_pressure_ticks", "fleet_autoscale_idle_ticks"),
     # ------------------------------------- elastic_* (coordinator)
     *_keys("elastic", "gauge",
            "elastic_hosts", "elastic_live", "elastic_done",

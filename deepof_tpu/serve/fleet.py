@@ -60,66 +60,50 @@ import dataclasses
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import threading
 import time
 from types import SimpleNamespace
 
+from ..core import supervise
 from ..core.config import ExperimentConfig
+from ..core.supervise import wait_for_listen  # noqa: F401 - re-export:
+#   tests/conftest.py and the chaos suites import it from here; the
+#   canonical definition moved to the shared supervisor core
 from .server import REPLICA_ENV
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
 
 #: Replica lifecycle states (Fleet._check is the transition table).
 #: "spawning" is the transient claim a monitor pass holds while it runs
-#: the (lock-free) process spawn for a slot.
+#: the (lock-free) process spawn for a slot. "retiring"/"retired" are
+#: the autoscaler's graceful scale-down path (serve/autoscale.py):
+#: routed around, drained, SIGTERMed, reaped — never counted as an
+#: eviction, because nothing was sick.
 STATES = ("spawning", "starting", "ready", "terminating", "backoff",
-          "broken", "stopped")
+          "broken", "stopped", "retiring", "retired")
 
 
-def wait_for_listen(host: str, port: int, timeout_s: float = 20.0,
-                    interval_s: float = 0.05) -> None:
-    """Block until something accepts TCP connections on host:port, or
-    raise TimeoutError — the connect-before-bind guard the fleet and the
-    test suite share (tests/conftest.py re-exports it)."""
-    deadline = time.monotonic() + max(float(timeout_s), 0.0)
-    while True:
-        if _listening(host, port):
-            return
-        if time.monotonic() >= deadline:
-            raise TimeoutError(f"nothing listening on {host}:{port} "
-                               f"within {timeout_s}s")
-        time.sleep(interval_s)
-
-
-def _listening(host: str, port: int) -> bool:
-    try:
-        with socket.create_connection((host, port), timeout=0.5):
-            return True
-    except OSError:
-        return False
-
-
-class _Replica:
+class _Replica(supervise.Child):
     """Supervisor-side record of one replica slot. All mutation happens
     under the fleet lock; the router sees only immutable snapshots."""
 
     def __init__(self, idx: int):
-        self.idx = idx
-        self.state = "stopped"
-        self.proc: subprocess.Popen | None = None
+        super().__init__(idx, "stopped")
         self.port: int | None = None
-        self.incarnation = 0
-        self.started_m = 0.0
         self.ready_m: float | None = None
         self.term_deadline = 0.0
         self.backoff_until = 0.0
         self.fast_failures = 0
-        self.last_exit: int | None = None
-        self.last_reason: str | None = None
+
+
+def _serve_in_flight(hb: dict) -> bool:
+    """The fleet's stall gate for the shared heartbeat verdict: the
+    stall clock is meaningful only while work is in flight (submitted >
+    answered — last_step_age_s only resets on beat() or the idle
+    touch(), and the serve sample touch()es only when everything
+    submitted is answered)."""
+    return (hb.get("serve_requests", 0) - hb.get("serve_responses", 0)
+            - hb.get("serve_errors", 0)) > 0
 
 
 class Fleet:
@@ -135,19 +119,49 @@ class Fleet:
         self.cfg = cfg
         self.fc = cfg.serve.fleet
         n = int(replicas) if replicas is not None else int(self.fc.replicas)
-        self.size = max(n, 1)
+        n = max(n, 1)
+        if self.fc.autoscale:
+            lo = max(int(self.fc.min_replicas), 1)
+            hi = max(int(self.fc.max_replicas), 1)
+            if lo > hi:
+                raise ValueError(
+                    f"serve.fleet.min_replicas={self.fc.min_replicas} > "
+                    f"max_replicas={self.fc.max_replicas}: the autoscale "
+                    "bounds are unsatisfiable — fix the config rather "
+                    "than let the pool pick a side")
+            # the autoscaler owns the pool size between its bounds:
+            # start inside them whatever --replicas said
+            n = min(max(n, lo), hi)
         self.dir = cfg.train.log_dir
         self.host = cfg.serve.host
         self._lock = threading.RLock()
-        self._replicas = [_Replica(i) for i in range(self.size)]
+        self._replicas = [_Replica(i) for i in range(n)]
         self._counters = {k: 0 for k in (
             "spawns", "respawns", "evictions", "crashes", "clean_exits",
             "wedge_evictions", "stale_evictions", "spawn_failures",
-            "kill_escalations", "broken")}
+            "kill_escalations", "broken", "retired")}
         self._stopping = False
+        self._active = n  # cached non-retired slot count (see size)
         self._wake = threading.Event()
+        # scale-down hook (run_fleet wires the router's map aging):
+        # called with the retired slot's idx AFTER the replica is gone
+        self.on_retired = None
         self._monitor = threading.Thread(target=self._run, daemon=True,
                                          name="fleet-monitor")
+
+    @property
+    def size(self) -> int:
+        """ACTIVE replica slots (everything but retired) — the modulus
+        of the router's affinity map and its sticky-cap factor. Fixed
+        for a plain fleet; shrinks/grows with the autoscaler's scale
+        events (slot indices stay monotonic — a retired index is never
+        reused, so per-index maps can age it out unambiguously). A
+        cached integer, maintained under the lock at the two mutation
+        sites (scale_up append, retire_one retirement) and read without
+        it — the router reads this up to three times per request, and
+        iterating a monotonically-growing slot list under the fleet
+        lock on the proxy hot path would contend with the monitor."""
+        return self._active
 
     # ------------------------------------------------------------ start
     def start(self) -> None:
@@ -190,43 +204,45 @@ class Fleet:
         ready_replicas() must not stall behind a respawn — and only the
         field publication at the end takes it."""
         rdir = self._replica_dir(r)
-        os.makedirs(rdir, exist_ok=True)
-        # a dead incarnation's heartbeat (possibly wedged:true after a
-        # SIGKILL skipped the final write) must not speak for the next
-        try:
-            os.remove(os.path.join(rdir, "heartbeat.json"))
-        except OSError:
-            pass
         rcfg = self.cfg.replace(
             train=dataclasses.replace(self.cfg.train, log_dir=rdir),
             serve=dataclasses.replace(
                 self.cfg.serve, port=0,
-                fleet=dataclasses.replace(self.fc, replicas=0)))
-        cfg_path = os.path.join(rdir, "config.json")
-        with open(cfg_path, "w") as f:
-            json.dump(dataclasses.asdict(rcfg), f, indent=2)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = (_REPO_ROOT + os.pathsep
-                             + env.get("PYTHONPATH", ""))
-        env[REPLICA_ENV] = str(r.idx)
-        if self.cfg.serve.fake_exec_ms is not None:
+                fleet=dataclasses.replace(self.fc, replicas=0,
+                                          autoscale=False)))
+        try:
+            cfg_path = supervise.prepare_child_dir(rdir, rcfg)
             # a fake-executor replica must never probe the accelerator
-            # tunnel (its import chain is jax-free; this is the backstop)
-            env.setdefault("JAX_PLATFORMS", "cpu")
-        with open(os.path.join(rdir, "stderr.log"), "ab") as stderr:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "deepof_tpu", "serve",
-                 "--config-json", cfg_path],
-                cwd=_REPO_ROOT, env=env, stdout=subprocess.PIPE,
-                stderr=stderr, text=True,
-                start_new_session=True)  # the parent's ^C is not theirs
+            # tunnel (import chain is jax-free; force_cpu is the backstop)
+            env = supervise.child_env(
+                extra={REPLICA_ENV: str(r.idx)},
+                force_cpu=self.cfg.serve.fake_exec_ms is not None)
+            with open(os.path.join(rdir, "stderr.log"), "ab") as stderr:
+                proc = supervise.spawn_child(
+                    [sys.executable, "-m", "deepof_tpu", "serve",
+                     "--config-json", cfg_path],
+                    env, subprocess.PIPE, stderr, text=True)
+        except OSError:
+            # fork/fd exhaustion or an unwritable replica dir — most
+            # likely under exactly the load that triggered a scale-up.
+            # The claimed slot must not stay a zombie "spawning" entry
+            # (the monitor skips that state forever): count it and
+            # route it through the same backoff/breaker ladder a
+            # spawn_failed death takes, so the monitor retries or opens
+            # the breaker.
+            with self._lock:
+                r.last_exit = None
+                r.last_reason = "spawn_failed"
+                self._counters["spawn_failures"] += 1
+                self._counters["evictions"] += 1
+                self._schedule_backoff(r)
+                self._log_event(r, "spawn failed (OSError); "
+                                   "scheduling respawn")
+            return
         with self._lock:
             if self._stopping:  # lost the race with close(): don't orphan
-                try:
-                    proc.kill()  # served nothing yet: no drain owed
-                    proc.wait()
-                except OSError:
-                    pass
+                supervise.kill_quietly(proc)  # served nothing: no drain owed
+                proc.wait()
                 r.state = "stopped"
                 return
             r.proc = proc
@@ -289,7 +305,7 @@ class Fleet:
             probe_ports = {r.idx: r.port for r in self._replicas
                            if r.state == "starting" and r.port is not None}
             hb_reads = [r for r in self._replicas if r.state == "ready"]
-        listening = {idx: _listening(self.host, port)
+        listening = {idx: supervise.listening(self.host, port)
                      for idx, port in probe_ports.items()}
         heartbeats = {r.idx: self._read_heartbeat(r) for r in hb_reads}
         with self._lock:
@@ -304,7 +320,11 @@ class Fleet:
         heartbeat results gathered unlocked by _poll_all). Returns True
         when the slot was claimed for a respawn the caller must perform
         (outside the lock)."""
-        if r.state in ("stopped", "broken", "spawning"):
+        if r.state in ("stopped", "broken", "spawning", "retired",
+                       "retiring"):
+            # "retiring" is owned end to end by retire_one (autoscale
+            # scale-down): already out of rotation, being drained —
+            # the health machine must not evict or respawn it
             return False
         alive = r.proc is not None and r.proc.poll() is None
         if r.state == "starting":
@@ -321,34 +341,35 @@ class Fleet:
                 return False
             if now - r.ready_m >= float(self.fc.healthy_after_s):
                 r.fast_failures = 0  # proved healthy: crash-loop reset
-            hb = heartbeats.get(r.idx)
-            if hb is not None and hb.get("pid") not in (None, r.proc.pid):
-                # a previous incarnation's file can neither vouch for
-                # nor condemn this process (a SIGKILLed wedged replica
-                # leaves wedged:true behind — _spawn also deletes it)
-                hb = None
-            if hb is not None and hb.get("wedged"):
+            # shared pid-gated verdict (core/supervise.py): wedged is
+            # the replica's own watchdog, stalled the supervisor-side
+            # detector (requests in flight, nothing completing, before
+            # the replica's watchdog — which needs 3 flushes — arms)
+            verdict = supervise.heartbeat_verdict(
+                heartbeats.get(r.idx), r.proc.pid, time.time(),
+                self.fc.stale_after_s, self.fc.stall_after_s,
+                stall_gate=_serve_in_flight)
+            if verdict == "wedged":
                 self._evict(r, "wedged", now)
-            elif hb is not None and self._stalled(hb):
-                # wedged before the replica's own watchdog armed (needs
-                # 3 completed flushes): requests in flight, nothing
-                # completing — the supervisor judges the stall itself
+            elif verdict == "stalled":
                 self._evict(r, "stalled", now)
-            elif self._heartbeat_stale(hb, r, now):
+            elif verdict == "stale":
                 self._evict(r, "stale", now)
+            elif verdict in ("no_heartbeat", "foreign_pid"):
+                # no current-incarnation file yet: grace from ready
+                if now - (r.ready_m or now) > float(self.fc.stale_after_s):
+                    self._evict(r, "stale", now)
         elif r.state == "terminating":
             if not alive:
                 self._to_backoff(r, now)
             elif now >= r.term_deadline:
-                try:
-                    r.proc.kill()  # SIGTERM grace expired: SIGKILL
-                except OSError:
-                    pass
+                supervise.kill_quietly(r.proc)  # SIGTERM grace expired
                 self._counters["kill_escalations"] += 1
                 r.term_deadline = now + 3600.0  # kill once; reap next poll
         elif r.state == "backoff":
             if now >= r.backoff_until:
-                if r.fast_failures >= int(self.fc.crash_loop_threshold):
+                if supervise.breaker_open(r.fast_failures,
+                                          self.fc.crash_loop_threshold):
                     r.state = "broken"
                     self._counters["broken"] += 1
                     self._log_event(r, "circuit breaker OPEN: "
@@ -361,36 +382,7 @@ class Fleet:
         return False
 
     def _read_heartbeat(self, r: _Replica) -> dict | None:
-        try:
-            with open(os.path.join(self._replica_dir(r),
-                                   "heartbeat.json")) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
-
-    def _stalled(self, hb: dict) -> bool:
-        """Pending-but-stalled verdict from the heartbeat CONTENT: work
-        in flight (submitted > answered) and no step/flush completion
-        for fleet.stall_after_s (last_step_age_s only resets on beat()
-        or the idle touch(), and the serve sample touch()es only when
-        everything submitted is answered)."""
-        stall_after = float(self.fc.stall_after_s)
-        if stall_after <= 0:
-            return False
-        in_flight = (hb.get("serve_requests", 0)
-                     - hb.get("serve_responses", 0)
-                     - hb.get("serve_errors", 0))
-        age = hb.get("last_step_age_s")
-        return (isinstance(age, (int, float)) and in_flight > 0
-                and age > stall_after)
-
-    def _heartbeat_stale(self, hb: dict | None, r: _Replica,
-                         now: float) -> bool:
-        stale_after = float(self.fc.stale_after_s)
-        if hb is None:  # no (current-incarnation) file yet: grace from ready
-            return now - (r.ready_m or now) > stale_after
-        t = hb.get("time")
-        return isinstance(t, (int, float)) and time.time() - t > stale_after
+        return supervise.read_heartbeat(self._replica_dir(r))
 
     # --------------------------------------------------- state changes
     def _evict(self, r: _Replica, reason: str, now: float) -> None:
@@ -407,10 +399,7 @@ class Fleet:
         r.port = None  # router stops picking it immediately
         self._log_event(r, f"evicting ({reason}): SIGTERM, SIGKILL after "
                            f"{self.fc.term_grace_s}s")
-        try:
-            r.proc.terminate()
-        except OSError:
-            pass
+        supervise.terminate_quietly(r.proc)
         r.state = "terminating"
         r.term_deadline = now + max(float(self.fc.term_grace_s), 0.0)
 
@@ -445,18 +434,15 @@ class Fleet:
         now = time.monotonic()
         fast = (r.ready_m is None
                 or now - r.ready_m < float(self.fc.healthy_after_s))
-        # only a FAST non-clean death counts toward the breaker: a slow
-        # death resets it (the breaker is for crash loops, not for a
-        # replica that served healthily and then died once), and a clean
-        # rc=0 exit never counts (rolling restarts — however quick —
-        # must not open the breaker; worst case is a capped-backoff
-        # respawn loop, which is visible in clean_exits, not an outage)
-        if clean:
-            pass  # counter unchanged: neither evidence for nor against
-        else:
-            r.fast_failures = r.fast_failures + 1 if fast else 0
-        delay = min(float(self.fc.backoff_s) * 2 ** (r.fast_failures - 1),
-                    float(self.fc.backoff_max_s))
+        # breaker arithmetic shared with every supervisor
+        # (core/supervise.py): only a FAST non-clean death counts — a
+        # slow death resets, a clean rc=0 exit (rolling restart) never
+        # counts either way
+        r.fast_failures = supervise.crash_loop_update(r.fast_failures,
+                                                      fast, clean=clean)
+        delay = supervise.backoff_delay(self.fc.backoff_s,
+                                        self.fc.backoff_max_s,
+                                        r.fast_failures)
         r.state = "backoff"
         r.port = None
         r.backoff_until = now + delay
@@ -488,8 +474,96 @@ class Fleet:
         discovered on the next monitor pass)."""
         self._wake.set()
 
+    # ------------------------------------------------------ autoscaling
+    def scale_up(self) -> int | None:
+        """Add one replica slot and spawn it (the autoscaler's scale-up
+        primitive). The new slot gets the next monotonic index — retired
+        indices are never reused, so the router's per-index maps stay
+        unambiguous across any number of scale events. Returns the new
+        index, or None when the fleet is stopping."""
+        with self._lock:
+            if self._stopping:
+                return None
+            r = _Replica(len(self._replicas))
+            r.state = "spawning"  # claimed; spawned below, unlocked
+            self._replicas.append(r)
+            self._active += 1
+        self._spawn(r)
+        if r.state != "backoff":  # spawn failure logs its own event
+            self._log_event(r, "scale-up: new replica slot spawned")
+        return r.idx
+
+    def begin_retire(self) -> _Replica | None:
+        """Claim the scale-down victim: the highest-index ready replica
+        leaves rotation IMMEDIATELY (state "retiring" — ready_replicas()
+        stops offering it, so the router admits nothing new there) but
+        keeps running so in-flight requests finish. None when no replica
+        is ready or the fleet is stopping."""
+        with self._lock:
+            if self._stopping:
+                return None
+            ready = [x for x in self._replicas if x.state == "ready"]
+            if not ready:
+                return None
+            victim = max(ready, key=lambda x: x.idx)
+            victim.state = "retiring"
+            victim.last_reason = "scale_down"
+            return victim
+
+    def retire_one(self, router=None) -> int | None:
+        """Graceful scale-down of ONE healthy replica — the eviction
+        ladder's drain half applied to a replica that did nothing
+        wrong: stop admission (begin_retire), wait out the router's
+        in-flight count for the slot (bounded by drain_timeout_s),
+        SIGTERM (the replica's own drain hook flushes any racing
+        request and exits 0), reap with SIGKILL escalation after
+        term_grace_s. Zero silent drops by construction: requests the
+        router already proxied complete inside the replica's drain, and
+        a request racing the SIGTERM fails transport and REPLAYS on a
+        sibling (the existing failover contract). Counted as `retired`,
+        never as an eviction — `tail`'s rc-4 contract stays about
+        sickness. Blocks (the autoscaler's thread); returns the retired
+        index or None."""
+        r = self.begin_retire()
+        if r is None:
+            return None
+        self._log_event(r, "scale-down: draining, then SIGTERM")
+        deadline = time.monotonic() + max(float(self.fc.drain_timeout_s),
+                                          0.0)
+        while (router is not None and router.in_flight_of(r.idx) > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        supervise.terminate_quietly(r.proc)
+        rc = supervise.reap_within(
+            r.proc, time.monotonic() + max(float(self.fc.term_grace_s), 0.1)
+            + max(float(self.fc.drain_timeout_s), 0.0))
+        with self._lock:
+            r.last_exit = rc
+            r.state = "retired"
+            r.port = None
+            r.proc = None
+            self._active -= 1
+            self._counters["retired"] += 1
+            if rc not in (0, None):
+                # SIGKILL escalation (wedged drain) or a crash that
+                # raced the retirement — the capacity was leaving either
+                # way, but the escalation stays visible
+                self._counters["kill_escalations"] += 1
+        self._log_event(r, f"retired (scale-down, rc={rc})")
+        hook = self.on_retired
+        if hook is not None:
+            try:
+                hook(r.idx)  # router ages out the slot's maps
+            except Exception:  # noqa: BLE001 - aging must not kill scaling
+                pass
+        return r.idx
+
     # ------------------------------------------------------------ stats
     def describe(self) -> list[dict]:
+        """ACTIVE slots only, like stats()'s states map — retired slots
+        would otherwise grow the /healthz payload by one permanent
+        entry per scale-up for the life of an oscillating fleet; the
+        `fleet_retired` counter accounts for them instead."""
         with self._lock:
             return [{"replica": r.idx, "state": r.state, "port": r.port,
                      "pid": r.proc.pid if r.proc is not None else None,
@@ -497,17 +571,23 @@ class Fleet:
                      "fast_failures": r.fast_failures,
                      "last_exit": r.last_exit,
                      "last_reason": r.last_reason}
-                    for r in self._replicas]
+                    for r in self._replicas if r.state != "retired"]
 
     def stats(self) -> dict:
-        """The supervisor's half of the fleet_* counter block."""
+        """The supervisor's half of the fleet_* counter block. The
+        states map covers ACTIVE slots only — retired slots leave it
+        (bounded however many scale events a long-lived fleet sees) and
+        are accounted by the `fleet_retired` counter instead."""
         with self._lock:
             c = dict(self._counters)
-            states = {f"replica-{r.idx}": r.state for r in self._replicas}
+            states = {f"replica-{r.idx}": r.state for r in self._replicas
+                      if r.state != "retired"}
             ready = sum(r.state == "ready" for r in self._replicas)
+            size = self._active  # the one non-retired count (see size)
         return {
-            "fleet_replicas": self.size,
+            "fleet_replicas": size,
             "fleet_ready": ready,
+            "fleet_retired": c["retired"],
             "fleet_states": states,
             "fleet_evictions": c["evictions"],
             "fleet_crashes": c["crashes"],
@@ -537,26 +617,17 @@ class Fleet:
             live = [(r, r.proc) for r in self._replicas
                     if r.proc is not None and r.proc.poll() is None]
             for r, proc in live:
-                try:
-                    proc.terminate()
-                except OSError:
-                    pass
+                supervise.terminate_quietly(proc)
         deadline = time.monotonic() + (float(self.fc.drain_timeout_s)
                                        + float(self.fc.term_grace_s))
         for r, proc in live:
-            try:
-                proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
-            except subprocess.TimeoutExpired:
-                try:
-                    proc.kill()
-                except OSError:
-                    pass
-                proc.wait()
+            rc = supervise.reap_within(proc, deadline)
             with self._lock:
-                r.last_exit = proc.returncode
+                r.last_exit = rc
         with self._lock:
             for r in self._replicas:
-                r.state = "stopped"
+                if r.state != "retired":
+                    r.state = "stopped"
                 r.port = None
 
     def __enter__(self) -> "Fleet":
@@ -598,6 +669,7 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
     router = None
     httpd = None
     hb = None
+    scaler = None
     # one teardown path for EVERY exit — replicas are detached
     # (start_new_session), so any escape without fleet.close() would
     # orphan serving processes: a partway-failed start() (EMFILE on
@@ -613,8 +685,20 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
             print(f"fleet: no replica became ready: {e}", file=sys.stderr)
             return 1
         router = Router(cfg, fleet)
+        # scale-down aging: a retired slot leaves the router's
+        # per-replica maps; its pinned sessions demote to session_lost
+        fleet.on_retired = router.retire_slot
         httpd = build_router_server(cfg, router)
         host, port = httpd.server_address[:2]
+
+        if cfg.serve.fleet.autoscale:
+            from .autoscale import Autoscaler
+
+            scaler = Autoscaler(cfg, fleet, router)
+            # scale counters ride router.stats(): /healthz, /metrics,
+            # the heartbeat sample and the shutdown record all see them
+            router.autoscale_stats = scaler.stats
+            scaler.start()
 
         hb_ref: dict = {}
 
@@ -653,6 +737,8 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
             pass
         return 0
     finally:
+        if scaler is not None:
+            scaler.close()  # no scale events during teardown
         if router is not None:
             router.draining = True  # stop admission
         if httpd is not None:
@@ -672,7 +758,9 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
 def _log_fleet_summary(cfg: ExperimentConfig, fleet: Fleet,
                        router) -> None:
     """One kind="serve" record with the final fleet_* block so
-    `deepof_tpu analyze`/`tail` surface fleet activity after exit."""
+    `deepof_tpu analyze`/`tail` surface fleet activity after exit.
+    router.stats() already folds in the autoscaler's block through the
+    autoscale_stats hook — one merge path, never two to drift."""
     try:
         os.makedirs(cfg.train.log_dir, exist_ok=True)
         rec = {"kind": "serve", "step": 0, "time": time.time(),

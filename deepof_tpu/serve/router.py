@@ -148,9 +148,20 @@ class Router:
         # called with the cumulative response count after each success —
         # the fleet heartbeat's beat() (run_fleet wires it)
         self.beat_hook: Callable[[int], None] | None = None
+        # the autoscaler's fleet_autoscale_* block (run_fleet wires
+        # Autoscaler.stats when fleet.autoscale): merged into stats()
+        # so scale counters ride /healthz, /metrics and the heartbeat
+        # exactly like every other fleet_* counter
+        self.autoscale_stats: Callable[[], dict] | None = None
         self._lock = threading.Lock()
         self._in_flight: dict[int, int] = defaultdict(int)
         self._routed: dict[int, int] = defaultdict(int)
+        # per-replica routed counts folded here when a slot retires
+        # (autoscale scale-down, Fleet.on_retired -> retire_slot): the
+        # per-index map stays bounded by the ACTIVE pool however many
+        # scale events a long-lived fleet sees, and the total stays
+        # monotonic
+        self._routed_retired = 0
         self._requests = 0
         self._responses = 0
         self._errors = 0
@@ -174,12 +185,12 @@ class Router:
         self._rid_seq = itertools.count(1)
         # sticky session -> (replica idx, last monotonic) map
         # (serve/session.py): bounded LRU mirroring the replicas' own
-        # session stores — per-replica capacity x fleet size, aged by
+        # session stores — per-replica capacity x CURRENT fleet size
+        # (recomputed per put: the autoscaler changes the pool), aged by
         # the same TTL, so the front can never pin more sessions than
         # the fleet can hold
         self._sticky: OrderedDict[str, tuple[int, float]] = OrderedDict()
-        self._sticky_cap = (max(int(cfg.serve.session.max_sessions), 1)
-                            * max(self.fleet.size, 1))
+        self._session_cap = max(int(cfg.serve.session.max_sessions), 1)
         self._sticky_ttl = float(cfg.serve.session.ttl_s)
         self._session_primes = 0   # sessions pinned (first frame routed)
         self._session_steps = 0    # frames routed via the sticky map
@@ -190,9 +201,15 @@ class Router:
     # ---------------------------------------------------------- routing
     def _preferred(self, key) -> int:
         """Affinity replica for a (bucket, tier) key: the flattened
-        (bucket x tier) ladder index modulo fleet size, so each
-        replica's hot AOT executables cover its slice of the full
-        ladder. With one tier this reduces to the pre-tier bucket map."""
+        (bucket x tier) ladder index modulo the CURRENT fleet size, so
+        each replica's hot AOT executables cover its slice of the full
+        ladder. With one tier this reduces to the pre-tier bucket map.
+        Under autoscale the modulus tracks the live pool and slot
+        indices are monotonic (a retired index is never reused), so the
+        preferred index may not name a live slot — _acquire's
+        ring-distance sort over the READY set still concentrates each
+        key on one deterministic replica; affinity is an optimization,
+        never a correctness dependency."""
         bucket, tier = key if key is not None else (None, None)
         if bucket is None or bucket not in self.buckets:
             # probe failed / unknown shape: round-robin, not replica 0 —
@@ -234,7 +251,8 @@ class Router:
 
     def _release(self, idx: int) -> None:
         with self._lock:
-            self._in_flight[idx] -= 1
+            if idx in self._in_flight:  # retire_slot may have aged it out
+                self._in_flight[idx] -= 1
 
     def _proxy(self, replica, path: str, body: bytes, ctype: str,
                request_id: str | None = None, method: str = "POST"):
@@ -274,13 +292,17 @@ class Router:
             return idx
 
     def _sticky_put(self, sid: str, idx: int) -> None:
+        # cap from the CURRENT pool size — a lock-free cached counter
+        # on the fleet, read before our lock only to keep the critical
+        # section minimal (no lock-ordering concern either way)
+        cap = self._session_cap * max(self.fleet.size, 1)
         with self._lock:
             fresh = sid not in self._sticky
             self._sticky[sid] = (idx, time.monotonic())
             self._sticky.move_to_end(sid)
             if fresh:
                 self._session_primes += 1
-            while len(self._sticky) > self._sticky_cap:
+            while len(self._sticky) > cap:
                 self._sticky.popitem(last=False)
                 self._session_evicted += 1
 
@@ -561,6 +583,30 @@ class Router:
                                          "died with it"}).encode(),
                 "application/json")
 
+    # --------------------------------------------------- scale-down aging
+    def in_flight_of(self, idx: int) -> int:
+        """Requests this router currently has proxied to one replica —
+        the drain gate `Fleet.retire_one` waits out before SIGTERMing a
+        retiring slot."""
+        with self._lock:
+            return self._in_flight.get(idx, 0)
+
+    def retire_slot(self, idx: int) -> None:
+        """Age a retired replica slot out of the per-index maps
+        (`Fleet.on_retired` — called AFTER the replica is drained,
+        stopped and reaped). The slot's routed count folds into the
+        retained `fleet_routed_retired` total (bounded map, monotonic
+        total); its in-flight entry — zero after the drain — is
+        dropped. Sticky sessions pinned to the slot deliberately KEEP
+        their entries: the next frame must demote to the structured 410
+        `session_lost` (PR 10's contract — silently dropping the pin
+        would re-prime mid-stream with no signal to the client), which
+        drops the entry; abandoned pins age out via the same TTL the
+        replica stores use."""
+        with self._lock:
+            self._in_flight.pop(idx, None)
+            self._routed_retired += self._routed.pop(idx, 0)
+
     # ------------------------------------------------------------ stats
     def in_flight_total(self) -> int:
         with self._lock:
@@ -585,6 +631,7 @@ class Router:
                 "fleet_in_flight": sum(self._in_flight.values()),
                 "fleet_routed": {f"replica-{i}": n
                                  for i, n in sorted(self._routed.items())},
+                "fleet_routed_retired": self._routed_retired,
                 "fleet_draining": self.draining,
                 # session-affinity axis (serve/session.py): sticky-map
                 # size + the pin/step/lost ledger `tail` surfaces
@@ -597,6 +644,12 @@ class Router:
             }
             requests, failures = self._requests, self._server_errors
         out["fleet_latency_hist"] = hist
+        scaler = self.autoscale_stats
+        if scaler is not None:
+            try:
+                out.update(scaler())
+            except Exception:  # noqa: BLE001 - obs never kills routing
+                pass
         if float(self.cfg.obs.slo_latency_ms) > 0:
             # the router's own histogram IS the burn source: it sees
             # every admitted request, including ones no replica answered

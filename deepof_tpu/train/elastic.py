@@ -97,11 +97,9 @@ import sys
 import threading
 import time
 
+from ..core import supervise
 from ..core.config import ExperimentConfig
 from ..resilience import verify as ckpt_verify
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
 
 #: Trainer-host lifecycle states (ElasticCoordinator._check_host is the
 #: transition table). Terminal: "lost" (never respawned), "done"
@@ -113,9 +111,19 @@ HOST_STATES = ("spawning", "starting", "running", "barrier", "lost",
 # --------------------------------------------------------------- verdicts
 
 
+def _trainer_stepped(hb: dict) -> bool:
+    """The coordinator's stall gate for the shared heartbeat verdict
+    (core/supervise.py): the stall clock is meaningful only once >= 1
+    beat completed — a first-dispatch XLA compile is never judged."""
+    return int(hb.get("beats") or 0) >= 1
+
+
 def host_verdict(hb: dict | None, pid: int | None, now_wall: float,
                  stale_after_s: float, wedge_after_s: float) -> str:
-    """Pure health verdict for one trainer from its heartbeat CONTENT.
+    """Pure health verdict for one trainer from its heartbeat CONTENT —
+    the shared supervisor verdict (`supervise.heartbeat_verdict`, the
+    same decision function the serving fleet judges replicas with) under
+    the coordinator's stall gate.
 
     Returns one of:
       "no_heartbeat"  — no (readable) file yet: pre-fit grace, judged
@@ -134,20 +142,9 @@ def host_verdict(hb: dict | None, pid: int | None, now_wall: float,
                         compile is never judged;
       "ok"            — healthy.
     """
-    if hb is None:
-        return "no_heartbeat"
-    if pid is not None and hb.get("pid") not in (None, pid):
-        return "foreign_pid"
-    if hb.get("wedged"):
-        return "wedged"
-    t = hb.get("time")
-    if isinstance(t, (int, float)) and now_wall - t > float(stale_after_s):
-        return "stale"
-    age = hb.get("last_step_age_s")
-    if (float(wedge_after_s) > 0 and int(hb.get("beats") or 0) >= 1
-            and isinstance(age, (int, float)) and age > float(wedge_after_s)):
-        return "stalled"
-    return "ok"
+    return supervise.heartbeat_verdict(hb, pid, now_wall, stale_after_s,
+                                       wedge_after_s,
+                                       stall_gate=_trainer_stepped)
 
 
 # ------------------------------------------------------- in-trainer chaos
@@ -252,21 +249,17 @@ def pace_to_world(world_file: str, generation: int, gstep: int,
 # ------------------------------------------------------------ coordinator
 
 
-class _TrainerHost:
+class _TrainerHost(supervise.Child):
     """Coordinator-side record of one trainer host (keyed by its
     ORIGINAL index — survivors keep their identity across re-forms, so
     a host-indexed fault schedule can never re-fire on a renumbered
-    neighbor)."""
+    neighbor). Built on the shared supervisor child record
+    (core/supervise.py); only the coordinator's monitor loop mutates
+    it."""
 
     def __init__(self, idx: int):
-        self.idx = idx
-        self.state = "spawning"
-        self.proc: subprocess.Popen | None = None
-        self.incarnation = 0
-        self.started_m = 0.0
+        super().__init__(idx, "spawning")
         self.last_step = 0
-        self.last_exit: int | None = None
-        self.last_reason: str | None = None
 
 
 class ElasticCoordinator:
@@ -355,14 +348,6 @@ class ElasticCoordinator:
         agrees on it (all spawns of a generation happen before the next
         poll can change the live set)."""
         hdir = self._host_dir(h)  # absolute (self.dir is)
-        os.makedirs(hdir, exist_ok=True)
-        # a dead incarnation's heartbeat must not speak for the next
-        # (the pid gate would reject it anyway; deleting keeps verdicts
-        # unambiguous)
-        try:
-            os.remove(os.path.join(hdir, "heartbeat.json"))
-        except OSError:
-            pass
         live_idx = sorted(x.idx for x in self._live())
         hcfg = self.cfg.replace(
             train=dataclasses.replace(self.cfg.train, log_dir=hdir),
@@ -371,23 +356,19 @@ class ElasticCoordinator:
                 num_hosts=len(live_idx), generation=self.generation,
                 primary_host=min(live_idx), target_step=self.target,
                 ckpt_dir=self.ckpt_dir, world_file=self.world_path))
-        cfg_path = os.path.join(hdir, "config.json")
-        with open(cfg_path, "w") as f:
-            json.dump(dataclasses.asdict(hcfg), f, indent=2)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = (_REPO_ROOT + os.pathsep
-                             + env.get("PYTHONPATH", ""))
-        if self.ec.virtual_devices > 0:
-            # virtual-host mode must never probe the accelerator tunnel;
-            # the child also calls force_cpu_devices before backend init
-            env.setdefault("JAX_PLATFORMS", "cpu")
+        # shared child-dir prep (core/supervise.py): mkdir, delete the
+        # dead incarnation's heartbeat (it must not speak for the next),
+        # serialize the child's EXACT config tree
+        cfg_path = supervise.prepare_child_dir(hdir, hcfg)
+        # virtual-host mode must never probe the accelerator tunnel;
+        # the child also calls force_cpu_devices before backend init
+        env = supervise.child_env(force_cpu=self.ec.virtual_devices > 0)
         with open(os.path.join(hdir, "stdout.log"), "ab") as out, \
                 open(os.path.join(hdir, "stderr.log"), "ab") as err:
-            h.proc = subprocess.Popen(
+            h.proc = supervise.spawn_child(
                 [sys.executable, "-m", "deepof_tpu", "train",
                  "--config-json", cfg_path, "--host-index", str(h.idx)],
-                cwd=_REPO_ROOT, env=env, stdout=out, stderr=err,
-                start_new_session=True)  # the parent's ^C is not theirs
+                env, out, err)
         h.incarnation += 1
         h.state = "starting"
         h.started_m = time.monotonic()
@@ -555,12 +536,7 @@ class ElasticCoordinator:
             pass
 
     def _read_heartbeat(self, h: _TrainerHost) -> dict | None:
-        try:
-            with open(os.path.join(self._host_dir(h),
-                                   "heartbeat.json")) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+        return supervise.read_heartbeat(self._host_dir(h))
 
     # ------------------------------------------------------------ reform
     def _reform(self, lost: list[_TrainerHost]) -> None:
@@ -617,10 +593,7 @@ class ElasticCoordinator:
         for h in survivors:
             h.state = "barrier"
             if h.proc is not None and h.proc.poll() is None:
-                try:
-                    h.proc.terminate()
-                except OSError:
-                    pass
+                supervise.terminate_quietly(h.proc)
         deadline = time.monotonic() + max(float(self.ec.barrier_timeout_s),
                                           1.0)
         for h in survivors:
@@ -629,10 +602,7 @@ class ElasticCoordinator:
             if not self._wait_supervising(h.proc, deadline):
                 self._counters["kill_escalations"] += 1
                 self._log_event(h, "barrier SIGTERM grace expired; SIGKILL")
-                try:
-                    h.proc.kill()
-                except OSError:
-                    pass
+                supervise.kill_quietly(h.proc)
                 h.proc.wait()
             h.last_exit = h.proc.returncode
             self._log_event(h, f"barrier stop complete (rc={h.last_exit})")
@@ -689,10 +659,7 @@ class ElasticCoordinator:
 
     def _kill(self, h: _TrainerHost) -> None:
         if h.proc is not None and h.proc.poll() is None:
-            try:
-                h.proc.kill()
-            except OSError:
-                pass
+            supervise.kill_quietly(h.proc)
             h.proc.wait()
             h.last_exit = h.proc.returncode
 
@@ -718,22 +685,13 @@ class ElasticCoordinator:
         self._stopping = True
         for h in self.hosts.values():
             if h.proc is not None and h.proc.poll() is None:
-                try:
-                    h.proc.terminate()
-                except OSError:
-                    pass
+                supervise.terminate_quietly(h.proc)
         deadline = time.monotonic() + max(float(self.ec.term_grace_s), 1.0)
         for h in self.hosts.values():
-            if h.proc is None:
-                continue
-            try:
-                h.proc.wait(timeout=max(deadline - time.monotonic(), 0.1))
-            except subprocess.TimeoutExpired:
-                try:
-                    h.proc.kill()
-                except OSError:
-                    pass
-                h.proc.wait()
+            if h.proc is not None:
+                # bounded reap, SIGKILL escalation on expiry (shared
+                # SIGTERM-then-SIGKILL ladder, core/supervise.py)
+                supervise.reap_within(h.proc, deadline)
 
     def __enter__(self) -> "ElasticCoordinator":
         return self

@@ -57,6 +57,17 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
      "higher"),
     ("bench_warm_epe_vs_cold_px",
      ("serve_bench_stream", "warm", "epe_vs_cold_px"), "lower"),
+    # r14 autoscaler ramp (serve_bench --ramp): scaled-burst throughput,
+    # how fast capacity arrived after the burst started, and the two
+    # hard invariants — sheds once scaled and silent drops — which
+    # should pin at/near 0 every round
+    ("bench_ramp_requests_per_s", ("serve_bench_ramp", "requests_per_s"),
+     "higher"),
+    ("bench_ramp_scale_up_latency_s",
+     ("serve_bench_ramp", "scale_up_latency_s"), "lower"),
+    ("bench_ramp_sheds_after_scale",
+     ("serve_bench_ramp", "sheds_after_scale"), "lower"),
+    ("bench_ramp_drops", ("serve_bench_ramp", "drops"), "lower"),
     ("bench_lint_wall_s", ("lint", "value"), "lower"),
     ("bench_elastic_recovery_s",
      ("elastic_drill", "host_loss", "recovery_wall_s"), "lower"),

@@ -91,6 +91,21 @@ FLEET_REQUIRED_KEYS = (
     "speedup_vs_single", "failovers", "shed", "max_batch", "exec_ms",
 )
 
+#: keys every --ramp result carries (schema smoke test): the bursty-load
+#: autoscaler exercise — staged warm/burst/scaled-burst/idle phases of
+#: closed-loop clients against a live autoscaling fleet. The ISSUE 14
+#: shape: sheds_burst > 0 at the min-replicas pool, scale_ups >= 1,
+#: sheds_after_scale ~ 0 once capacity arrived, scale_downs >= 1 after
+#: sustained idle (graceful drain: retired == scale_downs, evictions
+#: 0), drops == 0 (every admitted request resolved to a response).
+RAMP_REQUIRED_KEYS = (
+    "mode", "min_replicas", "max_replicas", "burst_clients", "phases",
+    "requests", "requests_per_s", "errors", "drops", "sheds_burst",
+    "sheds_after_scale", "scale_ups", "scale_downs", "retired",
+    "evictions", "peak_replicas", "final_replicas", "scale_up_latency_s",
+    "wall_s", "max_batch", "exec_ms", "max_in_flight",
+)
+
 #: keys every --stream result carries (schema smoke test). The warm_*
 #: block is the r11 temporal warm-start axis: a REAL-model warm-vs-cold
 #: walk over identical seeded frames — `warm_speedup` (ISSUE 11
@@ -742,6 +757,10 @@ def _scrape_metrics(port: int) -> dict:
         "serve_latency_sum_ms": samples.get("deepof_serve_latency_ms_sum"),
         # lint: counter-registry-ok(bench report field read back from /metrics)
         "fleet_latency_count": samples.get("deepof_fleet_latency_ms_count"),
+        # autoscale counters ride the same operator scrape path (None
+        # for a fixed, non-autoscaling fleet)
+        "fleet_autoscale_up": samples.get("deepof_fleet_autoscale_up"),
+        "fleet_autoscale_down": samples.get("deepof_fleet_autoscale_down"),
     }
 
 
@@ -824,11 +843,254 @@ def fleet_bench(replicas: int = 2, requests: int = 96, clients: int = 8,
     }
 
 
+# -------------------------------------------------------------- ramp
+
+
+def _drive_timed(port: int, body: bytes, clients: int,
+                 duration_s: float) -> dict:
+    """Closed-loop client pool for a fixed WINDOW (the ramp phases are
+    time-staged, not count-staged): every worker hammers until the
+    deadline. Returns {"ok", "errors", "drops"} — errors are structured
+    non-200 replies (shed 503s land here), drops are transport-level
+    failures where the client got NO response at all (the
+    zero-silent-drops ledger; the router must make this 0)."""
+    import http.client
+
+    deadline = time.perf_counter() + max(float(duration_s), 0.0)
+    ok = [0] * clients
+    err = [0] * clients
+    drops = [0] * clients
+
+    def worker(slot: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            while time.perf_counter() < deadline:
+                try:
+                    conn.request("POST", "/v1/flow", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status == 200:
+                        ok[slot] += 1
+                    else:
+                        err[slot] += 1
+                except Exception:  # noqa: BLE001 - a silent drop, counted
+                    drops[slot] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                      timeout=60)
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {"ok": sum(ok), "errors": sum(err), "drops": sum(drops),
+            "t0": round(t0, 2), "t1": round(time.time(), 2)}
+
+
+def _ramp_cfg(log_dir: str, max_replicas: int, max_batch: int,
+              timeout_ms: float, exec_ms: float, max_in_flight: int,
+              bucket: tuple[int, int]):
+    """Fast-cadence autoscaling fleet config: sub-second control loop,
+    short sustain windows/cooldowns — the same policy shape as
+    production, compressed so a bench run finishes in tens of seconds."""
+    import dataclasses as dc
+
+    cfg = _fleet_cfg(log_dir, max_batch, timeout_ms, exec_ms, bucket)
+    return cfg.replace(serve=dc.replace(
+        cfg.serve,
+        fleet=dc.replace(cfg.serve.fleet, autoscale=True, min_replicas=1,
+                         max_replicas=max_replicas,
+                         max_in_flight=max_in_flight,
+                         autoscale_period_s=0.25,
+                         autoscale_up_after_s=0.5,
+                         autoscale_down_after_s=2.0,
+                         autoscale_up_cooldown_s=1.0,
+                         autoscale_down_cooldown_s=2.0)))
+
+
+def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
+               warm_s: float = 2.0, burst_s: float = 8.0,
+               idle_s: float = 20.0, max_batch: int = 2,
+               timeout_ms: float = 2.0, exec_ms: float = 30.0,
+               max_in_flight: int = 4, bucket: tuple[int, int] = (32, 64),
+               native_hw: tuple[int, int] = (30, 60),
+               log_dir: str | None = None) -> dict:
+    """Bursty-load autoscaler exercise, end to end and in-process
+    (Fleet + Router + Autoscaler, fake-executor replica subprocesses):
+
+      warm    1 closed-loop client against the min_replicas pool —
+              the steady trickle a right-sized pool absorbs.
+      burst   `burst_clients` clients against the same 1-replica pool:
+              with max_in_flight * 1 slots the router SHEDS
+              (sheds_burst), occupancy pins at 1.0, and the autoscaler
+              scales up (scale_up_latency_s = burst start -> first
+              scale-up event).
+      scaled burst  once every scaled-up replica is ready (capacity
+              max_replicas * max_in_flight > burst_clients), the same
+              burst again: sheds_after_scale must collapse to ~0 —
+              the load-follower absorbed the burst.
+      idle    no load: sustained idle walks the pool back down via
+              graceful drain (retired == scale_downs, evictions == 0),
+              then one probe request proves the shrunken pool serves.
+
+    drops counts transport-level no-response failures across ALL
+    phases — the zero-silent-drops ledger; scale events ride the
+    router's /metrics scrape (`metrics_scrape`) exactly as an
+    operator's collector would see them."""
+    import tempfile
+
+    from deepof_tpu.serve.autoscale import Autoscaler
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+
+    base = log_dir or tempfile.mkdtemp(prefix="serve_bench_ramp_")
+    body = _flow_body(native_hw)
+    max_replicas = max(int(max_replicas), 2)
+    cfg = _ramp_cfg(base, max_replicas, max_batch, timeout_ms, exec_ms,
+                    max_in_flight, bucket)
+    fc = cfg.serve.fleet
+    phases: dict[str, dict] = {}
+    t_start = time.perf_counter()
+    t_run_wall = time.time()  # scale-record scan floor: a reused
+    #   --log-dir appends to an existing metrics.jsonl, and a previous
+    #   run's scale_up record would yield a bogus (negative) latency
+    with Fleet(cfg) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=1, timeout_s=fc.spawn_timeout_s)
+        router = Router(cfg, fleet)
+        fleet.on_retired = router.retire_slot
+        httpd = build_router_server(cfg, router)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        scaler = Autoscaler(cfg, fleet, router)
+        router.autoscale_stats = scaler.stats  # scrape-visible
+        scaler.start()
+        scrape = None
+        peak = fleet.size
+        try:
+            def shed_now() -> int:
+                rs = router.stats()
+                return rs["fleet_shed"] + rs["fleet_unavailable"]
+
+            phases["warm"] = _drive_timed(port, body, 1, warm_s)
+
+            shed0 = shed_now()
+            t_burst_wall = time.time()
+            phases["burst"] = _drive_timed(port, body, burst_clients,
+                                           burst_s)
+            sheds_burst = shed_now() - shed0
+
+            # hold: a light trickle while the scaled-up replicas finish
+            # spawning — a zero-load gap would sustain "idle" and walk
+            # the pool straight back down before the scaled burst could
+            # measure it (real bursts decay to baseline, not silence);
+            # 2 clients sit inside the hysteresis band at any pool size.
+            # The scaled burst measures CAPACITY, not startup latency,
+            # so wait until the pool can absorb the whole burst width.
+            hold = {"ok": 0, "errors": 0, "drops": 0,
+                    "t0": round(time.time(), 2)}
+            deadline = time.monotonic() + float(fc.spawn_timeout_s)
+            while time.monotonic() < deadline:
+                ready = fleet.stats()["fleet_ready"]
+                if (ready >= scaler.max
+                        or ready * max_in_flight > burst_clients):
+                    break
+                chunk = _drive_timed(port, body, 2, 0.5)
+                for k in ("ok", "errors", "drops"):
+                    hold[k] += chunk[k]
+            hold["t1"] = round(time.time(), 2)
+            phases["hold"] = hold
+            peak = max(peak, fleet.size)
+            up_events = scaler.stats()["fleet_autoscale_up"]
+            first_up = None
+            if up_events:
+                # first scale-up's latency from the burst start, read
+                # from the kind="fleet" records the autoscaler appended
+                try:
+                    with open(os.path.join(base, "metrics.jsonl")) as f:
+                        for line in f:
+                            rec = json.loads(line)
+                            if (rec.get("kind") == "fleet"
+                                    and rec.get("event") == "scale_up"
+                                    and rec.get("time", 0.0)
+                                    >= t_run_wall):
+                                first_up = rec["time"]
+                                break
+                except (OSError, ValueError):
+                    pass
+
+            shed1 = shed_now()
+            phases["scaled_burst"] = _drive_timed(port, body,
+                                                  burst_clients, burst_s)
+            sheds_after = shed_now() - shed1
+            peak = max(peak, fleet.size)
+
+            # idle: sustained zero load walks the pool back down
+            deadline = time.monotonic() + max(float(idle_s), 0.0)
+            while time.monotonic() < deadline:
+                if (scaler.stats()["fleet_autoscale_down"] > 0
+                        and fleet.size <= scaler.min):
+                    break
+                time.sleep(0.25)
+            probe = _drive_timed(port, body, 1, 1.0)  # shrunken pool serves
+            phases["probe"] = probe
+            try:
+                scrape = _scrape_metrics(port)
+            except Exception:  # noqa: BLE001 - scrape must not fail the bench
+                scrape = None
+            sstats = scaler.stats()
+            fstats = fleet.stats()
+        finally:
+            scaler.close()
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+    wall = time.perf_counter() - t_start
+
+    total = {k: sum(p[k] for p in phases.values())
+             for k in ("ok", "errors", "drops")}
+    burst_rate = (phases["scaled_burst"]["ok"] / burst_s
+                  if burst_s > 0 else None)
+    return {
+        "mode": "ramp", "min_replicas": 1, "max_replicas": max_replicas,
+        "burst_clients": burst_clients,
+        "phases": {name: dict(p) for name, p in phases.items()},
+        "requests": sum(total.values()),
+        "requests_per_s": (round(burst_rate, 2)
+                           if burst_rate is not None else None),
+        "errors": total["errors"],
+        "drops": total["drops"],
+        "sheds_burst": sheds_burst,
+        "sheds_after_scale": sheds_after,
+        "scale_ups": sstats["fleet_autoscale_up"],
+        "scale_downs": sstats["fleet_autoscale_down"],
+        "retired": fstats["fleet_retired"],
+        "evictions": fstats["fleet_evictions"],
+        "peak_replicas": peak,
+        "final_replicas": fstats["fleet_replicas"],
+        "scale_up_latency_s": (round(first_up - t_burst_wall, 2)
+                               if first_up else None),
+        "wall_s": round(wall, 2),
+        "max_batch": max_batch, "exec_ms": exec_ms,
+        "max_in_flight": max_in_flight, "bucket": list(bucket),
+        "log_dir": base,
+        "metrics_scrape": scrape,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serve_bench")
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--gap-ms", type=float, default=1.0)
-    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="batcher max coalesced pairs (default 8; "
+                         "2 in --ramp mode)")
     ap.add_argument("--timeout-ms", type=float, default=None,
                     help="batcher flush timeout (default 10; 2 in "
                          "--stream mode, where a closed-loop walk never "
@@ -852,7 +1114,21 @@ def main(argv=None) -> int:
                          "supervised subprocesses, closed-loop HTTP "
                          "clients) against a 1-replica fleet")
     ap.add_argument("--clients", type=int, default=8,
-                    help="fleet mode: concurrent closed-loop HTTP clients")
+                    help="fleet/ramp mode: concurrent closed-loop HTTP "
+                         "clients (the ramp's burst width)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="bursty-load autoscaler exercise (DESIGN.md "
+                         "\"Supervision plane\"): staged warm/burst/"
+                         "scaled-burst/idle phases of closed-loop "
+                         "clients against a live autoscaling fleet — "
+                         "sheds collapse after scale-up, sustained idle "
+                         "drains the pool back down, drops must be 0")
+    ap.add_argument("--max-replicas", type=int, default=3,
+                    help="ramp mode: autoscaler pool ceiling")
+    ap.add_argument("--burst-s", type=float, default=8.0,
+                    help="ramp mode: seconds per burst phase")
+    ap.add_argument("--idle-s", type=float, default=20.0,
+                    help="ramp mode: idle window for the scale-down leg")
     ap.add_argument("--stream", action="store_true",
                     help="benchmark the streaming video-session API: a "
                          "closed-loop session walk vs the equivalent "
@@ -899,12 +1175,30 @@ def main(argv=None) -> int:
     # per-mode defaults: a closed-loop stream walk never coalesces, so
     # the batch timeout and executor sleep are pure per-flow overhead
     # there — the other modes keep the historical 10 ms figures
+    user_exec, user_timeout, user_batch = \
+        args.exec_ms, args.timeout_ms, args.max_batch
     fast = 2.0 if args.stream else 10.0
     exec_ms = args.exec_ms if args.exec_ms is not None else fast
     timeout_ms = args.timeout_ms if args.timeout_ms is not None else fast
     args.exec_ms, args.timeout_ms = exec_ms, timeout_ms
+    args.max_batch = user_batch if user_batch is not None else 8
 
-    if args.stream:
+    if args.ramp:
+        # explicit flags pass through; absent ones keep the ramp's own
+        # tuned defaults (exec 30 ms / flush 2 ms / batch 2 — the shed-
+        # then-absorb dynamics the drill and BENCH figures are built on)
+        res = ramp_bench(max_replicas=args.max_replicas,
+                         burst_clients=args.clients,
+                         burst_s=args.burst_s, idle_s=args.idle_s,
+                         max_batch=user_batch if user_batch is not None
+                         else 2,
+                         exec_ms=user_exec if user_exec is not None
+                         else 30.0,
+                         timeout_ms=user_timeout if user_timeout is not None
+                         else 2.0,
+                         bucket=hw(args.bucket), native_hw=hw(args.native),
+                         log_dir=args.log_dir)
+    elif args.stream:
         res = stream_bench(frames=args.frames, decode_ms=args.decode_ms,
                            exec_ms=exec_ms, max_batch=args.max_batch,
                            timeout_ms=timeout_ms,
