@@ -323,7 +323,12 @@ def main(argv=None) -> int:
                      "(obs.slo_latency_ms / obs.slo_error_budget), 7 "
                      "when the label-free flow-quality drift verdict "
                      "fired (obs.quality_sample_rate / obs.quality_budget"
-                     " — with --fleet, any replica's verdict counts)")
+                     " — with --fleet, any replica's verdict counts), 8 "
+                     "when the executable ledger drifted against its "
+                     "baseline (HLO fingerprint drift, unexpected "
+                     "recompiles, compile blowups, memory growth — "
+                     "obs/ledger.py; with --fleet, any replica's ledger "
+                     "counts)")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
@@ -336,6 +341,25 @@ def main(argv=None) -> int:
     p_tail.add_argument("--follow", action="store_true",
                         help="re-print every --interval seconds until ^C")
     p_tail.add_argument("--interval", type=float, default=10.0)
+    p_tail.add_argument("--ledger-baseline", default=None, metavar="PATH",
+                        help="baseline ledger.jsonl for the executable-"
+                             "ledger drift verdict (exit 8). Default: "
+                             "<log-dir>/ledger_baseline.jsonl when "
+                             "present; no baseline = no verdict")
+    p_tail.add_argument("--ledger-compile-factor", type=float,
+                        default=None, metavar="X",
+                        help="compile-time blowup bound: fail when an "
+                             "executable's compile_s exceeds "
+                             "max(floor, baseline * X) (default 2.0)")
+    p_tail.add_argument("--ledger-compile-floor-s", type=float,
+                        default=None, metavar="S",
+                        help="compile-blowup floor in seconds — below "
+                             "it no compile time fails (default 1.0)")
+    p_tail.add_argument("--ledger-memory-factor", type=float,
+                        default=None, metavar="X",
+                        help="memory-growth bound: fail when arg+out+"
+                             "temp bytes exceed baseline * X "
+                             "(default 1.2)")
 
     args = parser.parse_args(argv)
 
@@ -397,14 +421,73 @@ def main(argv=None) -> int:
         # accelerator the trainer holds
         from .analyze import tail_summary
 
+        ledger_bounds = {
+            k: v for k, v in (
+                ("compile_factor", args.ledger_compile_factor),
+                ("compile_floor_s", args.ledger_compile_floor_s),
+                ("memory_factor", args.ledger_memory_factor))
+            if v is not None}
+        # a requested ledger gate must never silently pass: a typo'd
+        # baseline path or a run that recorded no ledger would
+        # otherwise yield "no verdict" => rc 0 forever (the standalone
+        # ledger_diff errors rc 1 on the same inputs — the two gates
+        # must agree). This covers the committed-by-convention
+        # <log_dir>/ledger_baseline.jsonl too: a convention file that
+        # EXISTS but holds no parseable rows is a broken gate, not the
+        # legitimate no-baseline case.
+        from .obs.ledger import (find_baseline, load_ledger,
+                                 resolve_ledger_path)
+
+        _base = args.ledger_baseline
+        if _base is not None:
+            # a run dir holding a ledger.jsonl is a valid baseline —
+            # the SAME resolution rule load_ledger/ledger_diff apply,
+            # shared so the two gates can never diverge on it
+            _p = resolve_ledger_path(_base)
+            if not os.path.isfile(_p):
+                raise SystemExit(f"tail: --ledger-baseline "
+                                 f"{_base!r} does not exist "
+                                 f"(expected a ledger.jsonl or a run dir "
+                                 f"holding one)")
+        else:
+            _p = find_baseline(args.log_dir)  # convention file or None
+        if _p is not None:
+            # the baseline side is STATIC — an empty/truncated file can
+            # never become valid, so even --follow must fail it loudly
+            # up front (ledger_verdict would return None and the gate
+            # would sit silently inert forever)
+            try:
+                _base_rows = load_ledger(_p)
+            except OSError as e:
+                raise SystemExit(f"tail: ledger baseline {_p!r} "
+                                 f"unreadable: {e}")
+            if not _base_rows:
+                raise SystemExit(f"tail: ledger baseline {_p!r} "
+                                 f"contains no ledger rows")
         while True:
             try:
                 summary = tail_summary(args.log_dir, recent=args.recent,
-                                       fleet=args.fleet)
+                                       fleet=args.fleet,
+                                       ledger_baseline=args.ledger_baseline,
+                                       ledger_bounds=ledger_bounds)
             except FileNotFoundError:
                 raise SystemExit(f"no metrics.jsonl under {args.log_dir!r} "
                                  "— is this a run's --log-dir?")
             print(json.dumps(summary), flush=True)
+            if (args.ledger_baseline is not None
+                    and "ledger_diff" not in summary
+                    and not args.follow):
+                # the explicit gate could not run: baseline unreadable
+                # or the run recorded no ledger — loud, never rc 0. In
+                # --follow mode keep following instead: a live run's
+                # ledger.jsonl only appears after its first compile
+                # (minutes, cold), and rc 3-7 likewise keep following
+                # until their condition actually fires.
+                raise SystemExit(f"tail: --ledger-baseline given but no "
+                                 f"verdict could be computed — is "
+                                 f"{args.ledger_baseline!r} a ledger and "
+                                 f"does {args.log_dir!r} hold a "
+                                 f"ledger.jsonl (obs.ledger on)?")
             # a wedged run must fail scripted health checks loudly: rc 3
             # when the heartbeat's watchdog has declared a wedge — in
             # --follow mode the loop ends at the first wedged heartbeat
@@ -451,6 +534,15 @@ def main(argv=None) -> int:
                 for child in (summary.get("processes") or {}).values()]
             if any((q or {}).get("exhausted") for q in quality_blocks):
                 return 7
+            # rc 8 when the executable ledger drifted against its
+            # baseline (obs/ledger.py diff_ledgers): HLO fingerprint
+            # drift, unexpected recompiles (misses where the baseline
+            # had hits), compile-time blowups, or memory-footprint
+            # growth past the bounds — the executables serving/training
+            # are NOT the ones the baseline measured. With --fleet, any
+            # replica's ledger verdict counts.
+            if (summary.get("ledger_diff") or {}).get("failed"):
+                return 8
             if not args.follow:
                 return 0
             import time as _time

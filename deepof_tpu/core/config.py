@@ -276,6 +276,16 @@ class ObsConfig:
     # compile): every periodic train record then carries model_tflops +
     # nominal MFU — the bench-only telemetry, promoted into training.
     flops: bool = True
+    # Executable ledger (obs/ledger.py, DESIGN.md "Executable ledger"):
+    # every lowering (train step, eval, the serve bucket x tier x mode
+    # lattice, quality scorers) appends a provenance row — StableHLO
+    # fingerprint, compile seconds, persistent-cache hit/miss, XLA cost
+    # analysis, memory footprint, donation map — to <log_dir>/
+    # ledger.jsonl, and the exec_* counter block rides heartbeat +
+    # /metrics. Costs nothing on the request hot path (rows are written
+    # at compile time); tools/ledger_diff.py + `tail` rc 8 turn the
+    # rows into a perf-regression gate against a committed baseline.
+    ledger: bool = True
     # --- Fleet observability plane (obs/export.py + obs/aggregate.py,
     # DESIGN.md "Fleet observability") ---
     # SLO latency target in ms: requests slower than this (rounded UP to

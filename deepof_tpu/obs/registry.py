@@ -56,7 +56,7 @@ from dataclasses import dataclass
 #: dict key with one of these prefixes written anywhere in the package
 #: must resolve in this registry.
 LINTED_PREFIXES: tuple[str, ...] = (
-    "serve_", "fleet_", "elastic_", "data_", "fault_")
+    "serve_", "fleet_", "elastic_", "data_", "fault_", "exec_")
 
 MERGE_KINDS: frozenset[str] = frozenset((
     "sum", "max", "gauge", "bool", "hist", "map", "state", "derived"))
@@ -183,6 +183,19 @@ _ENTRIES: list[Key] = [
            "fleet_autoscale_up", "fleet_autoscale_down",
            "fleet_autoscale_blocked_max",
            "fleet_autoscale_pressure_ticks", "fleet_autoscale_idle_ticks"),
+    # ------------------- exec_* (obs/ledger.py, the executable ledger:
+    # compile/HLO/memory provenance per lowering — DESIGN.md
+    # "Executable ledger"). Counters ride every stats surface that
+    # carries the engine block (heartbeat, /metrics, the fleet scrape,
+    # analyze/tail); the fingerprint map is per-process identity and the
+    # MFU is re-derived, never merged.
+    *_keys("ledger", "sum",
+           "exec_lowerings", "exec_recompiles", "exec_compile_s",
+           "exec_cache_hits", "exec_cache_misses", "exec_dispatches",
+           "exec_dispatch_s"),
+    Key("exec_executables", "gauge", "ledger"),
+    Key("exec_fingerprints", "state", "ledger"),
+    Key("exec_mfu_nominal", "derived", "ledger"),
     # ------------------------------------- elastic_* (coordinator)
     *_keys("elastic", "gauge",
            "elastic_hosts", "elastic_live", "elastic_done",

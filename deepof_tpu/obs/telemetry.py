@@ -21,6 +21,22 @@ import os
 NOMINAL_BF16_TFLOPS = 197.0
 
 
+def lowered_flops(lowered) -> float | None:
+    """FLOPs from an already-lowered module's cost analysis — the
+    shared extraction behind step_flops, split out so a caller that
+    holds a `jax.stages.Lowered` (the train loop reuses one lowering
+    for FLOPs AND the executable ledger's provenance row) never pays a
+    second trace. None when the backend does not report it."""
+    try:
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 - cost model is best-effort
+        return None
+
+
 def step_flops(step, *example_args) -> float | None:
     """XLA's FLOPs estimate for one call of a jitted `step`, from the
     LOWERED module (`jit(...).lower(...).cost_analysis()`) — traces but
@@ -30,11 +46,7 @@ def step_flops(step, *example_args) -> float | None:
     value is per-optimizer-step for any steps_per_call (bench.py has the
     verification notes). None when the backend does not report it."""
     try:
-        ca = step.lower(*example_args).cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        return flops if flops > 0 else None
+        return lowered_flops(step.lower(*example_args))
     except Exception:  # noqa: BLE001 - cost model is best-effort
         return None
 

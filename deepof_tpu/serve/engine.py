@@ -83,6 +83,7 @@ import numpy as np
 
 from ..core.config import ExperimentConfig
 from ..obs import trace as obs_trace
+from ..obs.ledger import ExecutableLedger, exec_name, quality_exec_name
 from ..obs.export import (LatencyHistogram, percentile_ms, slo_state,
                           validate_slo)
 from ..obs.quality import (QualityScorer, make_score_fn, quality_avals,
@@ -422,6 +423,20 @@ class InferenceEngine:
             self._forward = self._model_forward
         self._compiled: dict[tuple[tuple[int, int], str], object] = {}
         self._compile_lock = threading.Lock()
+        # executable ledger (obs/ledger.py): real-model engines append
+        # one provenance row per AOT lowering to <log_dir>/ledger.jsonl
+        # and export the exec_* block through stats() -> heartbeat +
+        # /metrics. Custom/fake executors have no XLA executables to
+        # ledger, and obs.ledger=false keeps the stats schema
+        # byte-identical to the pre-ledger stack. Hot-path cost is one
+        # timed dict update per flush (bounded <= 2% of serve p99 in
+        # serve_bench --ledger-overhead).
+        self._ledger: ExecutableLedger | None = None
+        if not self._forward_custom and bool(cfg.obs.ledger):
+            import jax
+
+            self._ledger = ExecutableLedger(
+                cfg.train.log_dir, backend=jax.default_backend())
 
         depth = max(int(cfg.serve.queue_depth), 0)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -818,6 +833,25 @@ class InferenceEngine:
                 prior = np.zeros((self.max_batch, ph, pw, 2), np.float32)
                 for i, r in enumerate(batch):
                     prior[i] = r.prior
+            if self._ledger is not None:
+                # resolve (compile/load) the executable BEFORE the timed
+                # window: the first flush's measured dispatch must be an
+                # execution, not compile+execution — the MFU denominator
+                # would otherwise be off by orders of magnitude. Same
+                # containment as the dispatch below: a compile failure
+                # (warm-grid ValueError, XLA error) fails this flush's
+                # futures, never the batcher thread.
+                try:
+                    self._executable(batch[0].key)
+                except Exception as e:  # noqa: BLE001 - contained per flush
+                    with self._stats_lock:
+                        self._dispatch_failures += 1
+                    for r in batch:
+                        self._fail(r.future, ServeError(
+                            "dispatch_failed", f"{type(e).__name__}: {e}",
+                            r.rid))
+                    return
+            t_fwd = time.perf_counter()
             try:
                 out = np.asarray(self._forward(batch[0].key, x,
                                                prior=prior))
@@ -828,6 +862,13 @@ class InferenceEngine:
                     self._fail(r.future, ServeError(
                         "dispatch_failed", f"{type(e).__name__}: {e}", r.rid))
                 return
+            if self._ledger is not None:
+                # per-executable measured dispatch time (host-synced):
+                # the denominator of the ledger's nominal-roofline MFU.
+                # One dict update per FLUSH, not per request — the whole
+                # ledger's hot-path cost.
+                self._ledger.note_exec(exec_name(bucket, tier, mode),
+                                       time.perf_counter() - t_fwd)
         with obs_trace.span("serve_postprocess", occupancy=n, bucket=tag,
                             request_ids=rids):
             for i, r in enumerate(batch):
@@ -915,7 +956,17 @@ class InferenceEngine:
         """The (bucket, tier, mode) triple's AOT-compiled forward —
         cold: the full network, warm: the refinement-only stage —
         compiled (or loaded from the persistent cache — the
-        `warmup --serve` contract) on first use."""
+        `warmup --serve` contract) on first use. Steady state is a
+        lock-free dict read (atomic in CPython; values are fully built
+        before insertion under the lock): with the ledger on, every
+        flush resolves the executable twice — the pre-resolve that
+        keeps compile time out of the measured-dispatch window, then
+        _model_forward — and taking the global compile lock both times
+        per flush is the per-request lock-churn class PR 14's review
+        removed from Fleet.size on this exact path."""
+        c = self._compiled.get(key)
+        if c is not None:
+            return c
         with self._compile_lock:
             c = self._compiled.get(key)
             if c is None:
@@ -946,21 +997,38 @@ class InferenceEngine:
                             f"the cold head grid {tuple(prior_hw)} — the "
                             f"session's prior would change shape after "
                             f"the first warm step")
-                    c = self._warm_jit.lower(params_sds, x_sds,
-                                             prior_sds).compile()
+                    c = self._compile_recorded(
+                        exec_name(bucket, tier, mode),
+                        lambda: self._warm_jit.lower(params_sds, x_sds,
+                                                     prior_sds))
                 else:
                     params_sds, x_sds = serve_avals(
                         self._params_by_tier[tier], bucket, self.max_batch)
-                    c = self._jit.lower(params_sds, x_sds).compile()
+                    c = self._compile_recorded(
+                        exec_name(bucket, tier, mode),
+                        lambda: self._jit.lower(params_sds, x_sds))
                 self._compiled[key] = c
         return c
+
+    def _compile_recorded(self, name: str, lower_fn):
+        """AOT-compile through the executable ledger when one is active
+        (provenance row: fingerprint, compile seconds, cache hit/miss,
+        cost/memory analysis, donation), else compile bare."""
+        if self._ledger is not None:
+            compiled, _ = self._ledger.record_aot(name, lower_fn)
+            return compiled
+        return lower_fn().compile()
 
     def _score_executable(self, bucket: tuple[int, int]):
         """The bucket's AOT-compiled quality scorer (obs/quality.py) —
         ONE executable per bucket (tiers and modes share it: the scorer
         consumes f32 inputs and f32 flow regardless of the tier that
         produced them), compiled (or loaded from the persistent cache —
-        the `warmup --serve` contract) on first use."""
+        the `warmup --serve` contract) on first use. Lock-free fast
+        path on hit, same double-checked pattern as _executable."""
+        c = self._score_compiled.get(bucket)
+        if c is not None:
+            return c
         with self._compile_lock:
             c = self._score_compiled.get(bucket)
             if c is None:
@@ -968,7 +1036,9 @@ class InferenceEngine:
                     self._jit, self._params_by_tier[self.default_tier],
                     bucket, self.max_batch)
                 x_sds, flow_sds = quality_avals(bucket, flow_hw)
-                c = self._score_jit.lower(x_sds, flow_sds).compile()
+                c = self._compile_recorded(
+                    quality_exec_name(bucket),
+                    lambda: self._score_jit.lower(x_sds, flow_sds))
                 self._score_compiled[bucket] = c
         return c
 
@@ -1077,6 +1147,12 @@ class InferenceEngine:
         # schema byte-identical to the pre-quality stack
         if self._quality is not None:
             out.update(self._quality.stats())
+        # executable-ledger block (obs/ledger.py): lowering/compile/
+        # cache counters + per-executable fingerprints + roofline MFU —
+        # present only for real-model engines with obs.ledger on, so
+        # fake-replica and ledger-off schemas stay byte-identical
+        if self._ledger is not None:
+            out.update(self._ledger.stats())
         # fixed-bucket histogram + SLO state (obs/export.py): the
         # scrapeable /metrics face; replica histograms merge exactly at
         # the router because the buckets are fixed by contract
@@ -1109,6 +1185,10 @@ class InferenceEngine:
         # consuming at this point).
         self._q.put(_STOP)
         self._thread.join(timeout=60.0)
+        if self._ledger is not None:
+            # after the batcher join: every flush's note_exec has landed,
+            # so the exec_timing rows carry the full run's measurements
+            self._ledger.flush()
         if self._quality is not None:
             # AFTER the batcher join: drained flushes still submit
             # samples, and the scorer's exit sentinel must queue behind

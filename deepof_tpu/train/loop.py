@@ -26,11 +26,12 @@ from ..data import InputPipeline, Prefetcher, build_dataset, derive_batch_rng
 from ..models.registry import build_model
 from ..obs import trace as obs_trace
 from ..obs.heartbeat import Heartbeat
+from ..obs.ledger import ExecutableLedger
 from ..obs.telemetry import (
     NOMINAL_BF16_TFLOPS,
     device_memory_summary,
+    lowered_flops,
     process_rss_bytes,
-    step_flops,
 )
 from ..parallel.mesh import batch_sharding, build_mesh
 from ..resilience.faults import build_injector
@@ -553,6 +554,16 @@ class Trainer:
         # completes within watchdog_factor x the median recent step time
         # — the historical "hung fetch on a dead tunnel" becomes a
         # diagnosable artifact instead of a silent stall.
+        # Executable ledger (obs/ledger.py): the live run's train-step
+        # provenance row — StableHLO fingerprint, first-step compile
+        # wall, persistent-cache hit/miss, cost analysis, donation map —
+        # appended to <log_dir>/ledger.jsonl at the first step, from the
+        # same lower-only retrace the FLOPs telemetry already pays.
+        # Memory-analysis fields stay None here (the jit-dispatch path
+        # has no AOT Compiled object; `warmup` rows carry them).
+        ledger = (ExecutableLedger(cfg.train.log_dir,
+                                   backend=jax.default_backend())
+                  if cfg.obs.ledger and primary else None)
         heartbeat = None
         if cfg.obs.heartbeat and primary:
 
@@ -560,9 +571,12 @@ class Trainer:
                 # resilience counters ride along (skipped_updates /
                 # rollbacks via timer.counters(), quarantine/retry/
                 # fallback via resilience_stats) so `deepof_tpu tail`
-                # sees recovery activity even between train records
+                # sees recovery activity even between train records;
+                # the exec_* ledger block does too once the first step
+                # has recorded the lowering
                 return {**timer.rates(), **timer.counters(),
-                        **resilience_stats()}
+                        **resilience_stats(),
+                        **(ledger.stats() if ledger is not None else {})}
 
             try:
                 heartbeat = Heartbeat(
@@ -795,11 +809,28 @@ class Trainer:
                                                               batch)
                         jax.block_until_ready(metrics["total"])
                     dc = cache_watch.stats()
-                    if cfg.obs.flops:
-                        # lower-only retrace (no second backend compile);
+                    first_wall = time.perf_counter() - t0
+                    lowered = None
+                    if cfg.obs.flops or ledger is not None:
+                        # ONE lower-only retrace (no second backend
+                        # compile) serves both the FLOPs telemetry and
+                        # the ledger's provenance row
+                        try:
+                            lowered = self.train_step.lower(self.state,
+                                                            batch)
+                        except Exception:  # noqa: BLE001 - telemetry only
+                            lowered = None
+                    if cfg.obs.flops and lowered is not None:
                         # every periodic record then carries model_tflops
-                        self._flops_per_step = step_flops(
-                            self.train_step, self.state, batch)
+                        self._flops_per_step = lowered_flops(lowered)
+                    if ledger is not None:
+                        # compile_kind="first_step": first_wall includes
+                        # one EXECUTED step stride, a different unit
+                        # from warmup's pure lower+compile "aot" rows —
+                        # diff_ledgers only bounds like against like
+                        ledger.record("train_step", lowered=lowered,
+                                      compile_s=first_wall,
+                                      compile_kind="first_step", cache=dc)
                     # hit/miss counters surfaced in metrics: a warmed
                     # process shows compile_cache_misses == 0 here
                     self.logger.log(

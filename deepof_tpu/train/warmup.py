@@ -26,7 +26,6 @@ r03->r04 host change, benign feature-hint warning).
 from __future__ import annotations
 
 import os
-import time
 from typing import Any
 
 import jax
@@ -235,10 +234,21 @@ def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
                            "steps_per_call": max(cfg.train.steps_per_call, 1),
                            "backend": jax.default_backend(),
                            "cache_dir": jax.config.jax_compilation_cache_dir}
+    # executable ledger (obs/ledger.py): every AOT compile below appends
+    # a provenance row (StableHLO fingerprint, compile seconds, cache
+    # hit/miss, cost analysis, memory footprint, donation map) to
+    # <log_dir>/ledger.jsonl — the baseline a later run's `tail`/
+    # ledger_diff drift verdict compares against
+    from ..obs.ledger import ExecutableLedger
+
+    ledger = ExecutableLedger(cfg.train.log_dir, enabled=cfg.obs.ledger,
+                              backend=jax.default_backend())
+    out["executables"] = []
     with cache_delta() as d:
-        t0 = time.perf_counter()
-        step.lower(state_sds, batch_sds).compile()
-        out["train_compile_s"] = round(time.perf_counter() - t0, 3)
+        _, row = ledger.record_aot(
+            "train_step", lambda: step.lower(state_sds, batch_sds))
+        out["train_compile_s"] = row["compile_s"]
+        out["executables"].append(_ledger_report_entry(row))
 
         if include_eval:
             # mirror Trainer.__init__'s eval_batch_size shard rounding so
@@ -249,11 +259,24 @@ def warmup_compile(cfg: ExperimentConfig, mesh=None, dataset=None,
                                    smooth_border_mask=smooth_border)
             eval_sds = _sds({key: np.asarray(v)
                              for key, v in dataset.sample_val(eval_bs, 0).items()})
-            t0 = time.perf_counter()
-            eval_fn.lower(state_sds.params, eval_sds).compile()
-            out["eval_compile_s"] = round(time.perf_counter() - t0, 3)
+            _, row = ledger.record_aot(
+                "eval_step",
+                lambda: eval_fn.lower(state_sds.params, eval_sds))
+            out["eval_compile_s"] = row["compile_s"]
+            out["executables"].append(_ledger_report_entry(row))
     out["cache"] = d.stats()
     return out
+
+
+def _ledger_report_entry(row: dict) -> dict:
+    """The per-executable line the warmup CLI report carries: name,
+    compile seconds, fingerprint, and the compile's own cache verdict —
+    a warm rerun that silently re-lowered one entry shows `misses: 1`
+    (and, next run, a drifted fingerprint) right at the CLI."""
+    return {"name": row["name"], "compile_s": row["compile_s"],
+            "fingerprint": row["fingerprint"],
+            "cache_hits": row["cache_hits"],
+            "cache_misses": row["cache_misses"]}
 
 
 def warmup_serve(cfg: ExperimentConfig) -> dict:
@@ -319,6 +342,14 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     score_jit = (jax.jit(make_score_fn())
                  if float(cfg.obs.quality_sample_rate) > 0 else None)
 
+    # executable ledger (obs/ledger.py): one provenance row per lattice
+    # entry, same naming scheme the engine uses at runtime — the
+    # committed-baseline side of the ledger_diff drift gate
+    from ..obs.ledger import (ExecutableLedger, exec_name,
+                              quality_exec_name)
+
+    ledger = ExecutableLedger(cfg.train.log_dir, enabled=cfg.obs.ledger,
+                              backend=jax.default_backend())
     out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
                            "backend": jax.default_backend(),
                            "cache_dir": jax.config.jax_compilation_cache_dir,
@@ -365,12 +396,13 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     variables_sds["params"])
                 for mode in modes:
                     before_files = _entries()
-                    bucket_delta = cache_delta()
-                    t0 = time.perf_counter()
+                    name = exec_name(bucket, tier, mode)
                     if mode == "cold":
                         params_sds, x_sds = serve_avals(
                             cold_tier_sds, bucket, max_batch)
-                        fwd.lower(params_sds, x_sds).compile()
+                        _, row = ledger.record_aot(
+                            name,
+                            lambda: fwd.lower(params_sds, x_sds))
                     else:
                         refine_tier_sds = jax.eval_shape(
                             lambda p, _t=tier: quantize_params(p, _t),
@@ -391,21 +423,24 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                                 f"refinement head grid "
                                 f"{tuple(out_sds.shape[1:3])} != cold "
                                 f"head grid {tuple(prior_hw)}")
-                        refine_fwd.lower(params_sds, x_sds,
-                                         prior_sds).compile()
-                    bd = bucket_delta.stats()
+                        _, row = ledger.record_aot(
+                            name,
+                            lambda: refine_fwd.lower(params_sds, x_sds,
+                                                     prior_sds))
+                    hits = row["cache_hits"] or 0
                     # persisted = a new on-disk entry appeared
                     # (filesystem truth, not the counter's hope) OR the
                     # compile was already a hit (the entry predates this
                     # call). Neither => the 1 s floor swallowed it:
                     # compiled fine, persisted nothing.
                     wrote = bool(_entries() - before_files)
-                    persisted = wrote or bd["hits"] >= 1
+                    persisted = wrote or hits >= 1
                     out["buckets"].append(
                         {"bucket": [h, w], "tier": tier, "mode": mode,
-                         "compile_s": round(time.perf_counter() - t0, 3),
+                         "compile_s": row["compile_s"],
+                         "fingerprint": row["fingerprint"],
                          "persisted": persisted,
-                         "status": ("hit" if bd["hits"] >= 1
+                         "status": ("hit" if hits >= 1
                                     else "persisted" if wrote
                                     else "skipped")})
             if score_jit is not None:
@@ -416,19 +451,20 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     lambda p: quantize_params(p, tiers[0]),
                     variables_sds["params"])
                 before_files = _entries()
-                bucket_delta = cache_delta()
-                t0 = time.perf_counter()
                 flow_hw = cold_output_hw(fwd, tier0_sds, bucket, max_batch)
                 x_sds, flow_sds = quality_avals(bucket, flow_hw)
-                score_jit.lower(x_sds, flow_sds).compile()
-                bd = bucket_delta.stats()
+                _, row = ledger.record_aot(
+                    quality_exec_name(bucket),
+                    lambda: score_jit.lower(x_sds, flow_sds))
+                hits = row["cache_hits"] or 0
                 wrote = bool(_entries() - before_files)
-                persisted = wrote or bd["hits"] >= 1
+                persisted = wrote or hits >= 1
                 out["buckets"].append(
                     {"bucket": [h, w], "tier": "-", "mode": "quality",
-                     "compile_s": round(time.perf_counter() - t0, 3),
+                     "compile_s": row["compile_s"],
+                     "fingerprint": row["fingerprint"],
                      "persisted": persisted,
-                     "status": ("hit" if bd["hits"] >= 1
+                     "status": ("hit" if hits >= 1
                                 else "persisted" if wrote
                                 else "skipped")})
     out["cache"] = d.stats()

@@ -1,0 +1,149 @@
+"""Deterministic executable-ledger fixture (tests/test_ledger.py).
+
+Builds `tests/fixtures/ledger/` — a frozen baseline ledger (the shape
+`warmup --serve` records: train/eval steps plus a serve lattice slice
+and a quality scorer, every row carrying the full obs/ledger.py
+ROW_KEYS schema) and two run dirs diffed against it:
+
+  run_clean/  a same-config warm rerun — identical fingerprints, every
+              compile a persistent-cache hit, identical footprints.
+              diff_ledgers must come back failed=false with zero
+              entries in every failure class.
+  run_drift/  one of EACH failure class the sentinel exists for:
+              train_step's HLO fingerprint drifted, eval_step's compile
+              missed where the baseline hit (unexpected recompile), the
+              serve cold executable's compile_s blew past
+              max(floor, baseline * factor), the warm executable's
+              arg+out+temp footprint grew past baseline * factor —
+              plus one new and one missing name, which are REPORTED but
+              never fail.
+
+Every timestamp and counter is fixed, so the diff_ledgers verdicts
+over the fixture are byte-for-byte reproducible; the goldens under
+`tests/fixtures/goldens/ledger_diff_{clean,drift}.json` pin them (rc 8
+semantics included — `failed` drives tail's exit code). Both run dirs
+also carry a minimal metrics.jsonl so `deepof_tpu tail` runs over them
+directly. Regenerate with `python tests/fixtures/make_ledger_fixture.py
+--record-goldens` from the repo root if the schema ever needs to grow,
+then re-verify the pinned verdicts by eye before committing.
+"""
+
+import json
+import os
+import sys
+
+BASE_TIME = 1700000000.0
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LEDGER_DIR = os.path.join(HERE, "ledger")
+GOLDENS = os.path.join(HERE, "goldens")
+
+#: the full obs/ledger.py lowering-row schema, frozen values — the
+#: fixture is also the ROW_KEYS pin's reference instance
+def _row(name, fingerprint, compile_s, hits, misses, *, arg_b, out_b,
+         temp_b, flops=2.5e9, bytes_accessed=5.0e8, t=10.0):
+    return {
+        "kind": "exec", "schema": 1, "name": name,
+        "time": BASE_TIME + t, "backend": "cpu",
+        "fingerprint": fingerprint, "hlo_chars": 4321,
+        "compile_s": compile_s, "compile_kind": "aot",
+        "cache_requests": hits + misses,
+        "cache_hits": hits, "cache_misses": misses,
+        "flops": flops, "bytes_accessed": bytes_accessed,
+        "arith_intensity": round(flops / bytes_accessed, 3),
+        "roofline_s": flops / (197.0 * 1e12),
+        "argument_bytes": arg_b, "output_bytes": out_b,
+        "temp_bytes": temp_b, "alias_bytes": 0, "code_bytes": 98765,
+        "donated_args": 160, "num_args": 164,
+    }
+
+
+def _timing(name, count, mean_s, roofline_s, t=90.0):
+    return {"kind": "exec_timing", "schema": 1, "name": name,
+            "time": BASE_TIME + t, "count": count,
+            "total_s": round(count * mean_s, 4), "mean_s": mean_s,
+            "mfu_nominal": round(roofline_s / mean_s, 6)}
+
+
+def baseline_rows():
+    """The committed-baseline side: a warmed run — every compile hit."""
+    return [
+        _row("train_step", "aaaa1111bbbb2222", 0.9, 1, 0,
+             arg_b=30_000_000, out_b=15_000_000, temp_b=8_000_000, t=10),
+        _row("eval_step", "cccc3333dddd4444", 0.4, 1, 0,
+             arg_b=10_000_000, out_b=5_000_000, temp_b=2_000_000, t=20),
+        _row("serve:32x64:f32:cold", "eeee5555ffff6666", 0.5, 1, 0,
+             arg_b=4_000_000, out_b=1_000_000, temp_b=500_000, t=30),
+        _row("serve:32x64:f32:warm", "9999aaaa0000bbbb", 0.3, 1, 0,
+             arg_b=4_100_000, out_b=1_000_000, temp_b=600_000, t=40),
+        _row("quality:32x64", "1212343456567878", 0.2, 1, 0,
+             arg_b=2_000_000, out_b=100_000, temp_b=50_000, t=50),
+        _timing("serve:32x64:f32:cold", 40, 0.004, 2.5e9 / 197e12),
+    ]
+
+
+def clean_rows():
+    """A same-config warm rerun: identical provenance, fresh times."""
+    return [dict(r, time=r["time"] + 1000.0) for r in baseline_rows()]
+
+
+def drift_rows():
+    """One of each failure class + one new / one missing name."""
+    rows = [
+        # fingerprint drift: the computation is not the baseline's
+        _row("train_step", "deadbeefdeadbeef", 0.9, 0, 1,
+             arg_b=30_000_000, out_b=15_000_000, temp_b=8_000_000,
+             t=1010),
+        # unexpected recompile: baseline hit, this run missed — same HLO
+        _row("eval_step", "cccc3333dddd4444", 0.5, 0, 1,
+             arg_b=10_000_000, out_b=5_000_000, temp_b=2_000_000,
+             t=1020),
+        # compile blowup: 1.2 s > max(floor 1.0, 0.5 * factor 2.0)
+        # (cache still hit — wall time regressed, provenance did not)
+        _row("serve:32x64:f32:cold", "eeee5555ffff6666", 1.2, 1, 0,
+             arg_b=4_000_000, out_b=1_000_000, temp_b=500_000, t=1030),
+        # memory growth: footprint * 1.3 > baseline * factor 1.2
+        _row("serve:32x64:f32:warm", "9999aaaa0000bbbb", 0.3, 1, 0,
+             arg_b=5_330_000, out_b=1_300_000, temp_b=780_000, t=1040),
+        # a new lattice entry (reported, never fails) ...
+        _row("serve:64x64:f32:cold", "0101232345456767", 0.6, 0, 1,
+             arg_b=8_000_000, out_b=2_000_000, temp_b=900_000, t=1050),
+        # ... and quality:32x64 deliberately absent (missing)
+    ]
+    return rows
+
+
+def write_jsonl(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("".join(json.dumps(r) + "\n" for r in rows))
+
+
+def main(record_goldens: bool = False) -> None:
+    write_jsonl(os.path.join(LEDGER_DIR, "baseline.jsonl"),
+                baseline_rows())
+    for name, rows in (("run_clean", clean_rows()),
+                       ("run_drift", drift_rows())):
+        d = os.path.join(LEDGER_DIR, name)
+        write_jsonl(os.path.join(d, "ledger.jsonl"), rows)
+        # a minimal metrics.jsonl so `deepof_tpu tail` runs over the
+        # fixture dir unmodified
+        write_jsonl(os.path.join(d, "metrics.jsonl"), [
+            {"kind": "train", "step": 10, "time": BASE_TIME + 100.0,
+             "total": 0.5}])
+    print(f"wrote ledger fixture: {LEDGER_DIR}")
+    if record_goldens:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+        from deepof_tpu.obs.ledger import diff_ledgers
+
+        for name, rows in (("clean", clean_rows()),
+                           ("drift", drift_rows())):
+            verdict = diff_ledgers(baseline_rows(), rows)
+            path = os.path.join(GOLDENS, f"ledger_diff_{name}.json")
+            with open(path, "w") as f:
+                json.dump(verdict, f)
+            print(f"recorded golden: {path} (failed={verdict['failed']})")
+
+
+if __name__ == "__main__":
+    main(record_goldens="--record-goldens" in sys.argv[1:])

@@ -31,7 +31,7 @@ import re
 #: keys every bench_trend report carries (schema smoke test)
 REQUIRED_KEYS = (
     "rounds", "latest_round", "files", "series", "best", "latest",
-    "regressions", "tolerance",
+    "regressions", "trend", "tolerance",
 )
 
 #: (series name, path through the BENCH json, "higher"|"lower" = better).
@@ -68,14 +68,65 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
     ("bench_ramp_sheds_after_scale",
      ("serve_bench_ramp", "sheds_after_scale"), "lower"),
     ("bench_ramp_drops", ("serve_bench_ramp", "drops"), "lower"),
+    # r15 executable ledger (obs/ledger.py + serve_bench
+    # --ledger-overhead): hot-path cost of ledgering (bounded <= 2%),
+    # total lattice compile seconds, and the measured-vs-nominal-
+    # roofline MFU of the bench engine's serve executable — the compile/
+    # perf provenance trajectory, per round
+    ("bench_ledger_overhead_pct", ("ledger", "p99_overhead_pct"),
+     "lower"),  # noise-centered: flagged via ABS_BOUNDS, not vs best
+    ("bench_ledger_compile_s", ("ledger", "compile_s_total"), "lower"),
+    ("bench_ledger_mfu", ("ledger", "mfu_nominal"), "higher"),
     ("bench_lint_wall_s", ("lint", "value"), "lower"),
     ("bench_elastic_recovery_s",
      ("elastic_drill", "host_loss", "recovery_wall_s"), "lower"),
     ("bench_quality_scorer_overhead_pct",
      ("serve_bench_quality", "scorer_overhead_pct"), "lower"),
+    ("bench_quality_p99_overhead_pct",
+     ("serve_bench_quality", "p99_overhead_pct"), "lower"),
     ("bench_quality_photo_f32", ("serve_bench_quality", "tiers", "f32",
                                  "photo"), "lower"),
 )
+
+#: noise-centered signed proxies: the overhead percentages hover around
+#: zero and go NEGATIVE on a contended host (the r14/r15 BENCH notes),
+#: so "worse than best-so-far by a fraction" is meaningless — a best of
+#: -0.5% would flag a later +0.6% that sits well inside the acceptance
+#: bound. These series regress ONLY when the newest value exceeds the
+#: ABSOLUTE bound their ISSUE acceptance set; None = no ISSUE set an
+#: absolute acceptance for this series (recorded, never auto-flagged).
+ABS_BOUNDS: dict[str, float | None] = {
+    "bench_ledger_overhead_pct": 2.0,       # ISSUE 15: <= 2% of p99
+    "bench_quality_p99_overhead_pct": 5.0,  # ISSUE 13: p99 < 5% at 0.1
+    # rps-based companion figure; ISSUE 13's 5% acceptance bounds the
+    # P99 overhead, not this one
+    "bench_quality_scorer_overhead_pct": None,
+}
+
+#: compile-seconds series are cache-BIMODAL: a round whose persistent
+#: compile cache is warm records ~0.05 s per executable, a cold round
+#: seconds-to-minutes — both healthy, so relative-to-best would flag
+#: every cold round as a phantom blowup against a cache-hit best. They
+#: flag with obs/ledger.py's own compile-blowup rule applied against
+#: the WORST prior round: latest > max(floor, prior_max * factor)
+#: (see _beyond for why best-so-far collapses the bound to the floor).
+COMPILE_FLOOR_S = 1.0
+COMPILE_FACTOR = 2.0
+
+
+def _is_compile_series(name: str) -> bool:
+    return (name == "bench_ledger_compile_s"
+            or name.startswith("ledger_compile_s:"))
+
+
+def _is_mfu_series(name: str) -> bool:
+    """Measured-MFU series are roofline_s / measured dispatch WALL, so
+    they scale inversely with host contention (the BENCH notes record
+    ~2x round-to-round host swings) — by the ledger's own rationale
+    ("wall time is host noise") they are recorded and sloped but never
+    auto-flagged."""
+    return (name == "bench_ledger_mfu"
+            or name.startswith("ledger_mfu_nominal:"))
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -116,30 +167,52 @@ def bench_trend(bench_dir: str, tolerance: float = 0.3) -> dict:
             value = _lookup(data, spec)
             if value is not None:
                 series[name].append({"round": rnd, "value": value})
+        # per-executable ledger series (dynamic names: the BENCH ledger
+        # block's "executables" map carries compile seconds and MFU per
+        # lattice entry — a single executable's compile-time trajectory
+        # is visible without opening the rounds by hand). Sense: compile
+        # seconds lower-is-better, MFU higher.
+        execs = (data.get("ledger") or {}).get("executables")
+        if isinstance(execs, dict):
+            for ename, entry in sorted(execs.items()):
+                if not isinstance(entry, dict):
+                    continue
+                for field, sense in (("compile_s", "lower"),
+                                     ("mfu_nominal", "higher")):
+                    v = entry.get(field)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        key = f"ledger_{field}:{ename}"
+                        series.setdefault(key, []).append(
+                            {"round": rnd, "value": v,
+                             "sense": sense})
 
     best: dict[str, dict] = {}
     latest: dict[str, dict] = {}
     regressions: dict[str, dict] = {}
-    for name, _, sense in PROXY_SPEC:
-        pts = series[name]
+    trend: dict[str, dict] = {}
+    senses = {name: sense for name, _, sense in PROXY_SPEC}
+    for name, pts in series.items():
         if not pts:
             continue
+        # static proxies carry their sense in PROXY_SPEC; dynamic
+        # per-executable ledger series carry it per point
+        sense = senses.get(name) or pts[-1].get("sense", "lower")
         pick = max if sense == "higher" else min
         b = pick(pts, key=lambda p: p["value"])
         last = pts[-1]
         best[name] = {"round": b["round"], "value": b["value"],
                       "sense": sense}
         latest[name] = {"round": last["round"], "value": last["value"]}
-        bv, lv = float(b["value"]), float(last["value"])
-        if bv == 0:
-            continue
-        worse = ((bv - lv) / abs(bv) if sense == "higher"
-                 else (lv - bv) / abs(bv))
-        if worse > float(tolerance):
+        t = _series_trend(name, pts, sense, tolerance)
+        if t is not None:
+            trend[name] = t
+        flagged, detail = _beyond(name, pts, sense, tolerance)
+        if flagged:
             regressions[name] = {
                 "best_round": b["round"], "best": b["value"],
                 "latest_round": last["round"], "latest": last["value"],
-                "worse_frac": round(worse, 4),
+                **detail,
             }
     return {
         "rounds": rounds,
@@ -149,7 +222,87 @@ def bench_trend(bench_dir: str, tolerance: float = 0.3) -> dict:
         "best": best,
         "latest": latest,
         "regressions": regressions,
+        "trend": trend,
         "tolerance": float(tolerance),
+    }
+
+
+def _beyond(name: str, pts: list[dict], sense: str,
+            tolerance: float) -> tuple[bool, dict]:
+    """The ONE regression rule, shared by bench_trend()'s regressions
+    map and _series_trend()'s `regressing` flag so the two can never
+    disagree about the same series. Four branches:
+
+      ABS_BOUNDS series   noise-centered signed overheads — flag only
+                          past the absolute acceptance bound (never,
+                          when the bound is None)
+      MFU series          wall-derived host noise — never auto-flag
+      compile series      cache-bimodal — the ledger's own blowup rule,
+                          but against the WORST prior round, not the
+                          best (best is a cache-hit round, whose 2x
+                          bound would collapse to the 1 s floor and
+                          phantom-flag every healthy >1 s cold compile;
+                          a genuine blowup is slower than any compile
+                          this series has ever recorded, by the factor
+                          and above the floor)
+      everything else     relative to best-so-far with `tolerance`
+
+    Returns (flagged, detail) — detail carries the branch's bound
+    fields for the regressions entry."""
+    pick = max if sense == "higher" else min
+    bv = float(pick(p["value"] for p in pts))
+    lv = float(pts[-1]["value"])
+    if name in ABS_BOUNDS:
+        bound = ABS_BOUNDS[name]
+        if bound is not None and lv > bound:
+            return True, {"abs_bound": bound}
+        return False, {}
+    if _is_mfu_series(name):
+        return False, {}
+    if _is_compile_series(name):
+        prior = [float(p["value"]) for p in pts[:-1]]
+        ref = max(prior) if prior else lv
+        if lv > max(COMPILE_FLOOR_S, ref * COMPILE_FACTOR):
+            return True, {"compile_floor_s": COMPILE_FLOOR_S,
+                          "compile_factor": COMPILE_FACTOR,
+                          "prior_max": ref}
+        return False, {}
+    if bv == 0:
+        return False, {}
+    worse = ((bv - lv) / abs(bv) if sense == "higher"
+             else (lv - bv) / abs(bv))
+    if worse > float(tolerance):
+        return True, {"worse_frac": round(worse, 4)}
+    return False, {}
+
+
+def _series_trend(name: str, pts: list[dict], sense: str,
+                  tolerance: float, window: int = 8) -> dict | None:
+    """Per-series slope + sustained-regression flag, the analyze.py
+    eval_trend shape ported to bench rounds: the least-squares slope of
+    value vs round over the newest `window` points, and `regressing` =
+    the slope moves the WRONG way for the series' sense AND the newest
+    point is beyond the series' regression rule (`_beyond` — the same
+    classifier the regressions map uses) — one noisy round never flags,
+    a sustained slide does. None below 3 points (a slope over 2 rounds
+    is just their difference)."""
+    recent = pts[-max(int(window), 3):]
+    if len(recent) < 3:
+        return None
+    xs = [float(p["round"]) for p in recent]
+    ys = [float(p["value"]) for p in recent]
+    n = len(xs)
+    mx, my = sum(xs) / n, sum(ys) / n
+    denom = sum((x - mx) ** 2 for x in xs)
+    if denom <= 0:
+        return None
+    slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+    adverse = slope < 0 if sense == "higher" else slope > 0
+    beyond, _ = _beyond(name, pts, sense, tolerance)
+    return {
+        "window": n,
+        "slope_per_round": round(slope, 6),
+        "regressing": bool(adverse and beyond),
     }
 
 
