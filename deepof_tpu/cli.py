@@ -87,7 +87,8 @@ def _build_cfg(args) -> ExperimentConfig:
     for flag, dotted in (("session_ttl", "serve.session.ttl_s"),
                         ("session_max", "serve.session.max_sessions"),
                         ("min_replicas", "serve.fleet.min_replicas"),
-                        ("max_replicas", "serve.fleet.max_replicas")):
+                        ("max_replicas", "serve.fleet.max_replicas"),
+                        ("artifacts", "serve.artifacts_dir")):
         value = getattr(args, flag, None)
         if value is not None:
             cfg = _apply_override(cfg, dotted, repr(value))
@@ -198,6 +199,16 @@ def main(argv=None) -> int:
     p_warm.add_argument("--serve-only", action="store_true",
                         help="compile only the serve ladder (skip "
                              "train/eval)")
+    p_warm.add_argument("--artifacts", default=None, metavar="DIR",
+                        help="publish each serve executable into this "
+                             "artifact store (serialized, fingerprint-"
+                             "keyed — DESIGN.md \"Artifact plane\"); "
+                             "engines/replicas started with the same "
+                             "store boot by fetching instead of "
+                             "compiling. Shorthand for "
+                             "--set serve.artifacts_dir=DIR; works "
+                             "cache-free with --serve-only (single-"
+                             "writer publish is cpu-safe)")
 
     p_srv = sub.add_parser(
         "serve", help="inference serving (DESIGN.md \"Serving\"): dynamic "
@@ -256,6 +267,13 @@ def main(argv=None) -> int:
                        metavar="N",
                        help="autoscaler pool ceiling — shorthand for "
                             "--set serve.fleet.max_replicas=N")
+    p_srv.add_argument("--artifacts", default=None, metavar="DIR",
+                       help="boot executables from this artifact store "
+                            "(`warmup --serve --artifacts DIR` publishes "
+                            "it): fetch + deserialize instead of "
+                            "compiling, fingerprint-gated — a cold "
+                            "replica's first request pays zero XLA. "
+                            "Shorthand for --set serve.artifacts_dir=DIR")
     p_srv.add_argument("--config-json", default=None,
                        help=argparse.SUPPRESS)  # fleet-internal: replica
     #                      processes load the supervisor's exact config
@@ -292,6 +310,29 @@ def main(argv=None) -> int:
              "in a run directory (jax-free; nonzero exit on corruption)")
     p_vck.add_argument("dir",
                        help="a run's --log-dir or its ckpt/ subdirectory")
+
+    p_art = sub.add_parser(
+        "artifacts",
+        help="executable artifact store (DESIGN.md \"Artifact plane\"): "
+             "list / verify / gc the fingerprint-keyed serialized AOT "
+             "executables `warmup --serve` publishes and replicas boot "
+             "from (jax-free; verify-ckpt's rc contract: 1 = corrupt "
+             "entries, 2 = empty store)")
+    p_art.add_argument("action", choices=("list", "verify", "gc"),
+                       help="list: one identity line per entry; verify: "
+                            "full structural verdicts (manifest + "
+                            "fingerprint + payload size/crc32); gc: "
+                            "remove corrupt entries and orphaned tmp "
+                            "staging dirs")
+    p_art.add_argument("--dir", default=None,
+                       help="store root (default: <repo>/artifacts/exec, "
+                            "serve.artifacts_dir's conventional home)")
+    p_art.add_argument("--older-than-days", type=float, default=None,
+                       metavar="DAYS",
+                       help="gc: also remove structurally VALID entries "
+                            "whose manifest is older than this many days "
+                            "(code churn strands fingerprints forever)")
+    p_art.add_argument("--json-indent", type=int, default=2)
 
     p_lint = sub.add_parser(
         "lint", help="graftlint: project-invariant static analysis "
@@ -413,6 +454,37 @@ def main(argv=None) -> int:
         if not report["checkpoints"]:
             print(f"verify-ckpt: no checkpoints under {args.dir!r}",
                   file=sys.stderr)
+            return 2
+        return 0
+
+    if args.cmd == "artifacts":
+        # jax-free by design (serve/artifacts.py's store half is
+        # stdlib): the store is listed/verified/gc'd from any machine —
+        # same contract as verify-ckpt (rc 1 corrupt, rc 2 empty)
+        from .serve.artifacts import (DEFAULT_STORE_DIR, gc_store,
+                                      verify_store)
+
+        root = args.dir or DEFAULT_STORE_DIR
+        if args.action == "gc":
+            report = gc_store(root, older_than_days=args.older_than_days)
+            print(json.dumps(report, indent=args.json_indent))
+            return 0
+        report = verify_store(root)
+        if args.action == "list":
+            print(json.dumps(
+                {"dir": report["dir"], "total": report["total"],
+                 "ok": report["ok"], "corrupt": report["corrupt"],
+                 "entries": [{"fingerprint": e["fingerprint"],
+                              "name": e["name"], "ok": e["ok"],
+                              "size": e["size"], "created": e["created"]}
+                             for e in report["entries"]]},
+                indent=args.json_indent))
+        else:
+            print(json.dumps(report, indent=args.json_indent))
+        if report["corrupt"]:
+            return 1
+        if not report["entries"]:
+            print(f"artifacts: empty store at {root!r}", file=sys.stderr)
             return 2
         return 0
 
@@ -653,17 +725,24 @@ def main(argv=None) -> int:
     if args.cmd == "warmup":
         from .train.warmup import enable_for_config, warmup_compile, warmup_serve
 
-        # the verb's sole purpose is populating the cache: refuse to
+        # the verb's sole purpose is persisting executables: refuse to
         # silently pay minutes of XLA and persist nothing. On cpu the
         # auto default disables the cache (TrainConfig.compile_cache —
         # cross-process read corruption on this host's jaxlib), so the
-        # user must opt in explicitly.
+        # user must opt in explicitly. EXCEPTION: `--serve-only` with
+        # serve.artifacts_dir set persists through the artifact plane
+        # (serve/artifacts.py — single-writer publish, no concurrent
+        # cache reads), which is exactly the cpu-safe path.
         if enable_for_config(cfg) is None:
-            print("warmup: persistent compile cache is not active for "
-                  "this config/backend (cpu auto-disables it; add --set "
-                  "train.compile_cache=true to opt in) — nothing would "
-                  "be persisted, refusing to compile", file=sys.stderr)
-            return 2
+            if not (args.serve_only and cfg.serve.artifacts_dir):
+                print("warmup: persistent compile cache is not active "
+                      "for this config/backend (cpu auto-disables it; "
+                      "add --set train.compile_cache=true to opt in, or "
+                      "publish serve executables cache-free with "
+                      "--serve-only --set serve.artifacts_dir=PATH) — "
+                      "nothing would be persisted, refusing to compile",
+                      file=sys.stderr)
+                return 2
         if args.serve_only:
             res = warmup_serve(cfg)
         else:

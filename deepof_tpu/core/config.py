@@ -430,6 +430,14 @@ class FleetConfig:
     # above which NEW latency breaches count as pressure — capacity is
     # added while the budget still has headroom, not after exhaustion
     autoscale_up_slo_burn: float = 0.5
+    # Predictive pressure (ISSUE 16): requests/s GROWTH (req/s per
+    # second, least-squares slope over the router's per-second
+    # completion buckets) at or above this counts a tick as pressure —
+    # the pool scales on the load *trend*, before occupancy saturates
+    # or the first shed lands. The same up_after_s sustain window and
+    # cooldowns apply, so one noisy second never spawns a replica.
+    # <= 0 disables the slope signal (reactive-only, the r14 behavior).
+    autoscale_up_slope: float = 0.0
     # no second scale-up within this window of the previous one: a
     # burst must not spawn the whole ladder before the first new
     # replica has even compiled
@@ -532,6 +540,16 @@ class ServeConfig:
     # This is how fleet tests and `serve_bench --fleet` run replica
     # subprocesses cheaply; None = the real restored model.
     fake_exec_ms: float | None = None
+    # Executable artifact store (serve/artifacts.py, DESIGN.md
+    # "Artifact plane"): directory of fingerprint-keyed serialized AOT
+    # executables. `warmup --serve` publishes into it (single writer);
+    # engine/replica startup fetches+deserializes instead of compiling,
+    # keyed by the StableHLO fingerprint of the LOCAL lowering so
+    # drifted code can never load a stale artifact. "" = disabled
+    # (every process compiles, the pre-r16 behavior). The path rides
+    # the parent->replica config.json handoff, so fleet children and
+    # autoscale spawns boot from the same store.
+    artifacts_dir: str = ""
     # Streaming video sessions (serve/session.py): POST /v1/flow/stream
     # keeps the last frame per session so consecutive pairs cost one
     # decode, not two; the router pins each session to one replica.

@@ -70,11 +70,11 @@ LEDGER_SCHEMA = 1
 #: does not report a value) — the schema the fixture test pins.
 ROW_KEYS = (
     "kind", "schema", "name", "time", "backend", "fingerprint",
-    "hlo_chars", "compile_s", "compile_kind", "cache_requests",
-    "cache_hits", "cache_misses", "flops", "bytes_accessed",
-    "arith_intensity", "roofline_s", "argument_bytes", "output_bytes",
-    "temp_bytes", "alias_bytes", "code_bytes", "donated_args",
-    "num_args",
+    "hlo_chars", "compile_s", "resolve_s", "compile_kind", "cache_requests",
+    "cache_hits", "cache_misses", "cache_verdict", "flops",
+    "bytes_accessed", "arith_intensity", "roofline_s", "argument_bytes",
+    "output_bytes", "temp_bytes", "alias_bytes", "code_bytes",
+    "donated_args", "num_args",
 )
 
 # MLIR location metadata is the one part of the printed module that is
@@ -191,8 +191,10 @@ def _donation(lowered) -> tuple[int | None, int | None]:
 
 def lowering_row(name: str, lowered=None, compiled=None,
                  compile_s: float | None = None,
+                 resolve_s: float | None = None,
                  compile_kind: str | None = None,
                  cache: dict | None = None,
+                 cache_verdict: str | None = None,
                  backend: str | None = None) -> dict:
     """One ledger row for a lowering. `lowered` (jax.stages.Lowered)
     supplies the fingerprint, cost analysis, and donation map;
@@ -200,19 +202,35 @@ def lowering_row(name: str, lowered=None, compiled=None,
     None where a site has no AOT-compiled object (the train loop's
     jit-dispatch compile) and the fields stay None rather than paying a
     second XLA compile just to fill them. `compile_kind` says what
-    compile_s MEASURES — "aot" (pure lower+compile, record_aot) vs
+    compile_s MEASURES — "aot" (pure lower+compile, record_aot),
     "first_step" (the train loop's first-step wall: compile + one
-    executed step) — so diff_ledgers never compares the two units."""
+    executed step), or "artifact" (lower + fetch/deserialize from the
+    artifact store, NO compile at all) — so diff_ledgers never compares
+    the units. `cache_verdict` names where the executable came from:
+    explicit "artifact_hit" from the artifact plane, else derived from
+    the persistent-cache delta ("hit"/"miss"), else None."""
     row: dict[str, Any] = {k: None for k in ROW_KEYS}
     row.update({"kind": "exec", "schema": LEDGER_SCHEMA, "name": name,
                 "time": round(time.time(), 3), "backend": backend})
     if compile_s is not None:
         row["compile_s"] = round(float(compile_s), 4)
         row["compile_kind"] = compile_kind
+    if resolve_s is not None:
+        # the resolution step alone — XLA compile ("aot") or artifact
+        # fetch+deserialize ("artifact") — with the shared trace/lower
+        # wall excluded; compile_s keeps the historical lower+resolve
+        # total so existing baselines stay comparable
+        row["resolve_s"] = round(float(resolve_s), 4)
     if cache:
         for k in ("requests", "hits", "misses"):
             if isinstance(cache.get(k), int):
                 row[f"cache_{k}"] = cache[k]
+    if cache_verdict is not None:
+        row["cache_verdict"] = cache_verdict
+    elif (row.get("cache_hits") or 0) >= 1:
+        row["cache_verdict"] = "hit"
+    elif (row.get("cache_misses") or 0) >= 1:
+        row["cache_verdict"] = "miss"
     ca = None
     if lowered is not None:
         try:
@@ -278,6 +296,13 @@ class ExecutableLedger:
         self._compile_s = 0.0
         self._cache_hits = 0
         self._cache_misses = 0
+        # artifact-plane fetch accounting (serve/artifacts.py):
+        # hits = executables deserialized instead of compiled,
+        # misses = no entry for the local fingerprint (compiled),
+        # rejects = entry present but failed an integrity gate (compiled)
+        self._artifact_hits = 0
+        self._artifact_misses = 0
+        self._artifact_rejects = 0
         # per-executable measured execution time: name -> [count, total_s,
         # roofline_s] — MFU = roofline / mean measured, re-derived at
         # stats() time, never merged (registry kind: derived)
@@ -297,14 +322,18 @@ class ExecutableLedger:
 
     def record(self, name: str, lowered=None, compiled=None,
                compile_s: float | None = None,
+               resolve_s: float | None = None,
                compile_kind: str | None = None,
-               cache: dict | None = None) -> dict:
+               cache: dict | None = None,
+               cache_verdict: str | None = None) -> dict:
         """Build, count, and append one lowering row (see lowering_row).
         Returns the row so call sites can fold the fingerprint into
         their own reports (the warmup CLI report does)."""
         row = lowering_row(name, lowered=lowered, compiled=compiled,
-                           compile_s=compile_s, compile_kind=compile_kind,
-                           cache=cache, backend=self.backend)
+                           compile_s=compile_s, resolve_s=resolve_s,
+                           compile_kind=compile_kind,
+                           cache=cache, cache_verdict=cache_verdict,
+                           backend=self.backend)
         with self._lock:
             self._lowerings += 1
             if compile_s is not None:
@@ -328,20 +357,52 @@ class ExecutableLedger:
             self._append(row)
         return row
 
-    def record_aot(self, name: str, lower_fn: Callable[[], Any]) -> Any:
-        """The shared AOT helper: time lower_fn() -> Lowered, compile it,
-        measure the persistent-cache delta of exactly this compile, and
-        record the row. Returns (compiled, row)."""
+    def record_aot(self, name: str, lower_fn: Callable[[], Any],
+                   artifacts=None) -> Any:
+        """The shared AOT helper: time lower_fn() -> Lowered, then
+        resolve the executable — from the artifact store when one is
+        passed (serve/artifacts.py ArtifactStore, keyed by THIS
+        lowering's StableHLO fingerprint, so drifted code always
+        misses) and only otherwise by compiling — measure the
+        persistent-cache delta of exactly this resolution, and record
+        the row: compile_kind "artifact" + cache_verdict "artifact_hit"
+        on a fetch, the ordinary "aot" row on a compile (miss, reject,
+        or no store). Returns (compiled, row)."""
         from ..train.warmup import cache_delta
 
+        verdict = None
         with cache_delta() as d:
             t0 = time.perf_counter()
             lowered = lower_fn()
-            compiled = lowered.compile()
+            t_res = time.perf_counter()
+            compiled = None
+            if artifacts is not None:
+                try:
+                    fp = fingerprint_text(lowered.as_text())
+                    compiled, verdict = artifacts.fetch(fp)
+                except Exception:  # noqa: BLE001 - store is best-effort
+                    compiled, verdict = None, "reject:fetch_failed"
+            if compiled is None:
+                t_res = time.perf_counter()  # a reject's failed fetch
+                #   is not compile wall: resolve_s stays the step that
+                #   actually produced the executable
+                compiled = lowered.compile()
             dt = time.perf_counter() - t0
+            resolve_s = time.perf_counter() - t_res
+        hit = verdict == "hit"
+        if artifacts is not None:
+            with self._lock:
+                if hit:
+                    self._artifact_hits += 1
+                elif verdict == "miss":
+                    self._artifact_misses += 1
+                else:
+                    self._artifact_rejects += 1
         row = self.record(name, lowered=lowered, compiled=compiled,
-                          compile_s=dt, compile_kind="aot",
-                          cache=d.stats())
+                          compile_s=dt, resolve_s=resolve_s,
+                          compile_kind="artifact" if hit else "aot",
+                          cache=d.stats(),
+                          cache_verdict="artifact_hit" if hit else None)
         return compiled, row
 
     def note_exec(self, name: str, seconds: float) -> None:
@@ -367,6 +428,9 @@ class ExecutableLedger:
                 "exec_compile_s": round(self._compile_s, 3),
                 "exec_cache_hits": self._cache_hits,
                 "exec_cache_misses": self._cache_misses,
+                "exec_artifact_hits": self._artifact_hits,
+                "exec_artifact_misses": self._artifact_misses,
+                "exec_artifact_rejects": self._artifact_rejects,
                 "exec_executables": len(self._fingerprints),
                 "exec_fingerprints": dict(self._fingerprints),
                 "exec_dispatches": sum(e[0] for e in self._exec.values()),
@@ -534,7 +598,13 @@ def diff_ledgers(baseline: list[dict], run: list[dict],
       unexpected_recompiles the baseline's compile was a persistent-
                             cache hit but this run's missed — a silent
                             cold-start regression (cache key drift,
-                            evicted cache, version skew)
+                            evicted cache, version skew). Rows whose
+                            compile_kind is "artifact" (either side)
+                            never enter this check: an artifact load
+                            is a FETCH, not a compile, so its zero
+                            cache activity is healthy, not a miss —
+                            no spurious rc 8 from booting off the
+                            artifact plane
       compile_blowups       compile_s exceeded
                             max(compile_floor_s, baseline * factor) —
                             compared ONLY between rows whose
@@ -560,7 +630,9 @@ def diff_ledgers(baseline: list[dict], run: list[dict],
         bf, rf = b.get("fingerprint"), r.get("fingerprint")
         if bf and rf and bf != rf:
             drift.append({"name": name, "baseline": bf, "run": rf})
-        if ((b.get("cache_hits") or 0) >= 1
+        if (b.get("compile_kind") != "artifact"
+                and r.get("compile_kind") != "artifact"
+                and (b.get("cache_hits") or 0) >= 1
                 and (b.get("cache_misses") or 0) == 0
                 and (r.get("cache_misses") or 0) >= 1):
             recompiles.append({

@@ -160,6 +160,10 @@ _ENTRIES: list[Key] = [
     Key("fleet_draining", "bool", "router"),
     Key("fleet_latency_hist", "hist", "router"),
     Key("fleet_slo", "derived", "router"),
+    # load trend from the router's per-second completion buckets
+    # (ISSUE 16 predictive autoscaling): recent requests/s and its
+    # least-squares slope (req/s per second) — instantaneous, per-router
+    *_keys("router", "gauge", "fleet_load_rps", "fleet_load_slope"),
     # ----------------------------------- fleet_* (supervisor half)
     *_keys("fleet", "gauge", "fleet_replicas", "fleet_ready"),
     Key("fleet_states", "state", "fleet"),
@@ -182,7 +186,12 @@ _ENTRIES: list[Key] = [
     *_keys("fleet", "sum",
            "fleet_autoscale_up", "fleet_autoscale_down",
            "fleet_autoscale_blocked_max",
-           "fleet_autoscale_pressure_ticks", "fleet_autoscale_idle_ticks"),
+           "fleet_autoscale_pressure_ticks", "fleet_autoscale_idle_ticks",
+           # ticks where the PREDICTIVE load-slope signal (ISSUE 16,
+           # fleet.autoscale_up_slope) was the pressure source before
+           # any shed/breach landed — how often the pool scaled ahead
+           # of the load instead of behind it
+           "fleet_autoscale_slope_ticks"),
     # ------------------- exec_* (obs/ledger.py, the executable ledger:
     # compile/HLO/memory provenance per lowering — DESIGN.md
     # "Executable ledger"). Counters ride every stats surface that
@@ -192,7 +201,14 @@ _ENTRIES: list[Key] = [
     *_keys("ledger", "sum",
            "exec_lowerings", "exec_recompiles", "exec_compile_s",
            "exec_cache_hits", "exec_cache_misses", "exec_dispatches",
-           "exec_dispatch_s"),
+           "exec_dispatch_s",
+           # artifact plane (serve/artifacts.py): executables
+           # deserialized from the store instead of compiled (hits) vs
+           # compiled because no entry matched the local fingerprint
+           # (misses) vs compiled because an entry failed an integrity
+           # gate (rejects — always loud)
+           "exec_artifact_hits", "exec_artifact_misses",
+           "exec_artifact_rejects"),
     Key("exec_executables", "gauge", "ledger"),
     Key("exec_fingerprints", "state", "ledger"),
     Key("exec_mfu_nominal", "derived", "ledger"),

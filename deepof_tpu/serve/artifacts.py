@@ -1,0 +1,307 @@
+"""Executable artifact plane: fingerprint-keyed AOT executables on disk
+(DESIGN.md "Artifact plane").
+
+Scale-up latency is the fleet's dominant tax: every respawn and
+autoscale-up pays the full (bucket x tier x mode) lattice compile,
+because the r06 heap-corruption finding forces the persistent XLA cache
+OFF for concurrent cpu children. This module replaces the *compile*
+with a *fetch*: ``warmup --serve`` (the single writer) serializes each
+AOT-compiled executable (``jax.experimental.serialize_executable``)
+under ``artifacts/exec/<stablehlo-fingerprint>/`` next to an
+atomic-rename manifest, and every engine/replica start deserializes
+instead of compiling.
+
+Integrity model — the fingerprint IS the gate:
+
+  - The store key is the StableHLO fingerprint of the *local* lowering
+    (obs/ledger.py ``fingerprint_text``), recomputed by every consumer
+    at fetch time. Drifted code lowers to different StableHLO, which
+    hashes to a different key, which is a store MISS — a stale artifact
+    can never load against changed code.
+  - The manifest must agree with its own directory name, the payload's
+    size/crc32, the backend, and the jax version; any mismatch is a
+    loud ``reject:*`` (stderr warn + ledger counter) and the caller
+    falls back to the ordinary compile path.
+  - Publish stages into a ``.tmp-<pid>-*`` sibling and ``os.rename``s
+    the whole directory into place: readers never observe a torn entry,
+    and concurrent publishers resolve first-writer-wins (renaming onto
+    an existing entry fails, which is "exists", not an error).
+
+Single-writer publish + read-only consumers is exactly the discipline
+the cross-process persistent-cache corruption violated; the artifact
+plane gets warm starts on this host without reopening that wound.
+
+Import discipline: module import is stdlib-only so the ``deepof_tpu
+artifacts`` CLI verb (list/verify/gc) stays jax-free; jax is imported
+inside the serialize/deserialize helpers only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+import zlib
+
+#: manifest schema version — bumped on any layout change so old entries
+#: reject (schema mismatch) instead of deserializing garbage
+SCHEMA = 1
+
+MANIFEST = "manifest.json"
+BLOB = "exec.bin"
+
+#: default store root: lives under artifacts/ with the other
+#: cross-session state (hostmesh.COMPILE_CACHE_DIR convention) so one
+#: rsync of artifacts/ carries warm executables to a fresh host
+DEFAULT_STORE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "artifacts", "exec")
+
+
+def _is_fingerprint(name: str) -> bool:
+    return (len(name) == 16
+            and all(c in "0123456789abcdef" for c in name))
+
+
+def store_entries(root: str) -> list[str]:
+    """Fingerprint directory names present in the store (sorted); tmp
+    staging dirs and strangers are not entries."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    return sorted(n for n in names
+                  if _is_fingerprint(n)
+                  and os.path.isdir(os.path.join(root, n)))
+
+
+def verify_entry(root: str, fingerprint: str) -> dict:
+    """Structural verdict for one entry — manifest parses, fingerprint
+    agrees with the directory name, payload size/crc32 agree with the
+    manifest. jax-free: deserialization is NOT attempted here (that is
+    the consumer's job, behind the same gates plus backend/version)."""
+    entry = {"fingerprint": fingerprint, "ok": False, "why": None,
+             "name": None, "backend": None, "size": None, "crc32": None,
+             "created": None}
+    d = os.path.join(root, fingerprint)
+    man_path = os.path.join(d, MANIFEST)
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        entry["why"] = f"manifest_unreadable: {e}"
+        return entry
+    entry["name"] = man.get("name")
+    entry["backend"] = man.get("backend")
+    entry["created"] = man.get("created")
+    if man.get("schema") != SCHEMA:
+        entry["why"] = f"schema_mismatch: {man.get('schema')!r}"
+        return entry
+    if man.get("fingerprint") != fingerprint:
+        entry["why"] = (f"fingerprint_mismatch: manifest says "
+                        f"{man.get('fingerprint')!r}")
+        return entry
+    payload = man.get("payload") or {}
+    blob_path = os.path.join(d, payload.get("file") or BLOB)
+    try:
+        blob = open(blob_path, "rb").read()
+    except OSError as e:
+        entry["why"] = f"payload_unreadable: {e}"
+        return entry
+    entry["size"] = len(blob)
+    entry["crc32"] = zlib.crc32(blob)
+    if payload.get("size") != len(blob):
+        entry["why"] = (f"size_mismatch: manifest {payload.get('size')} "
+                        f"!= {len(blob)}")
+        return entry
+    if payload.get("crc32") != entry["crc32"]:
+        entry["why"] = "crc_mismatch"
+        return entry
+    entry["ok"] = True
+    return entry
+
+
+def verify_store(root: str) -> dict:
+    """Whole-store structural report: every entry's verdict plus the
+    corrupt/ok split and leftover tmp staging dirs (a publisher that
+    died mid-stage)."""
+    fps = store_entries(root)
+    entries = [verify_entry(root, fp) for fp in fps]
+    tmp = []
+    try:
+        tmp = sorted(n for n in os.listdir(root) if n.startswith(".tmp-"))
+    except OSError:
+        pass
+    return {
+        "dir": root,
+        "entries": entries,
+        "total": len(entries),
+        "ok": sum(1 for e in entries if e["ok"]),
+        "corrupt": [e["fingerprint"] for e in entries if not e["ok"]],
+        "tmp_dirs": tmp,
+    }
+
+
+def gc_store(root: str, older_than_days: float | None = None) -> dict:
+    """Garbage-collect the store: corrupt entries and orphaned tmp
+    staging dirs always go; with ``older_than_days`` set, structurally
+    valid entries whose manifest ``created`` stamp is older also go
+    (code churn strands entries forever — their fingerprints never
+    recur — so age is the only useful liveness signal)."""
+    report = verify_store(root)
+    removed, kept = [], []
+    now = time.time()
+    for e in report["entries"]:
+        drop = not e["ok"]
+        if (not drop and older_than_days is not None
+                and isinstance(e["created"], (int, float))
+                and now - e["created"] > older_than_days * 86400.0):
+            drop = True
+        if drop:
+            shutil.rmtree(os.path.join(root, e["fingerprint"]),
+                          ignore_errors=True)
+            removed.append(e["fingerprint"])
+        else:
+            kept.append(e["fingerprint"])
+    for t in report["tmp_dirs"]:
+        shutil.rmtree(os.path.join(root, t), ignore_errors=True)
+    return {"dir": root, "removed": removed, "kept": kept,
+            "tmp_removed": report["tmp_dirs"]}
+
+
+# --------------------------------------------------------- jax half
+
+
+def _serialize_compiled(compiled) -> bytes:
+    """One self-contained blob per executable: the PJRT payload plus the
+    pickled in/out tree defs ``deserialize_and_load`` needs. Local
+    trusted store (single writer = this repo's own warmup), so pickle
+    for the tree defs is acceptable."""
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree))
+
+
+def _deserialize_compiled(blob: bytes):
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return se.deserialize_and_load(payload, in_tree, out_tree)
+
+
+class ArtifactStore:
+    """Fingerprint-keyed executable store bound to one backend.
+
+    ``fetch(fingerprint)`` -> ``(compiled | None, verdict)`` where
+    verdict is ``"hit"``, ``"miss"`` (no entry — including every
+    drifted-code case, because the caller keys by its OWN lowering's
+    fingerprint), or ``"reject:<why>"`` (entry exists but failed an
+    integrity gate; warned loudly on stderr). ``publish`` is the single
+    writer's atomic-rename path and returns ``"published"`` /
+    ``"exists"`` / ``"error:<why>"`` — never raises into the warmup.
+    """
+
+    def __init__(self, root: str, backend: str | None = None):
+        self.root = str(root)
+        self.backend = backend
+
+    # consumers ------------------------------------------------------
+
+    def fetch(self, fingerprint: str):
+        d = os.path.join(self.root, fingerprint)
+        if not os.path.isfile(os.path.join(d, MANIFEST)):
+            return None, "miss"
+        entry = verify_entry(self.root, fingerprint)
+        if not entry["ok"]:
+            return None, self._reject(fingerprint, entry["why"])
+        with open(os.path.join(d, MANIFEST)) as f:
+            man = json.load(f)
+        import jax
+        if self.backend and man.get("backend") != self.backend:
+            return None, self._reject(
+                fingerprint, f"backend_mismatch: artifact is for "
+                             f"{man.get('backend')!r}, we run "
+                             f"{self.backend!r}")
+        if man.get("jax") != jax.__version__:
+            return None, self._reject(
+                fingerprint, f"jax_version_mismatch: artifact from "
+                             f"{man.get('jax')!r}, we run "
+                             f"{jax.__version__!r}")
+        blob_path = os.path.join(d, (man.get("payload") or {}).get("file")
+                                 or BLOB)
+        try:
+            with open(blob_path, "rb") as f:
+                compiled = _deserialize_compiled(f.read())
+        except Exception as e:  # noqa: BLE001 - any failure = fall back
+            return None, self._reject(fingerprint,
+                                      f"deserialize_failed: {e}")
+        return compiled, "hit"
+
+    @staticmethod
+    def _reject(fingerprint: str, why: str) -> str:
+        print(f"artifacts: REJECT {fingerprint}: {why} — falling back "
+              f"to compile", file=sys.stderr)
+        return f"reject:{why.split(':', 1)[0]}"
+
+    # the single writer ----------------------------------------------
+
+    def publish(self, fingerprint: str, compiled, *, name: str = "",
+                compile_s: float | None = None, meta: dict | None = None
+                ) -> str:
+        final = os.path.join(self.root, fingerprint)
+        if os.path.isfile(os.path.join(final, MANIFEST)):
+            return "exists"
+        try:
+            import jax
+
+            blob = _serialize_compiled(compiled)
+            man = {
+                "schema": SCHEMA,
+                "fingerprint": fingerprint,
+                "name": name,
+                "backend": self.backend or jax.default_backend(),
+                "jax": jax.__version__,
+                "compile_s": compile_s,
+                "created": time.time(),
+                "payload": {"file": BLOB, "size": len(blob),
+                            "crc32": zlib.crc32(blob)},
+            }
+            if meta:
+                man.update(meta)
+            tmp = os.path.join(self.root,
+                               f".tmp-{os.getpid()}-{fingerprint}")
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, BLOB), "wb") as f:
+                f.write(blob)
+            with open(os.path.join(tmp, MANIFEST), "w") as f:
+                json.dump(man, f, indent=2, sort_keys=True)
+            try:
+                os.rename(tmp, final)
+            except OSError:
+                # someone else won the rename race; their entry stands
+                shutil.rmtree(tmp, ignore_errors=True)
+                return "exists"
+            return "published"
+        except Exception as e:  # noqa: BLE001 - publish is best-effort
+            print(f"artifacts: publish {fingerprint} failed: {e}",
+                  file=sys.stderr)
+            return f"error:{type(e).__name__}"
+
+
+def store_for_config(cfg) -> ArtifactStore | None:
+    """The store a serve config asks for, or None when the plane is off
+    (``serve.artifacts_dir`` empty). Resolved to an absolute path so
+    replica subprocesses (their own cwd) read the same store."""
+    root = getattr(cfg.serve, "artifacts_dir", "")
+    if not root:
+        return None
+    import jax
+    return ArtifactStore(os.path.abspath(os.path.expanduser(root)),
+                         backend=jax.default_backend())

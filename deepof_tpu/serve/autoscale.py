@@ -83,7 +83,8 @@ class Autoscaler:
         self.period_s = max(float(self.fc.autoscale_period_s), 0.05)
         self._lock = threading.Lock()
         self._counters = {k: 0 for k in (
-            "up", "down", "blocked_max", "pressure_ticks", "idle_ticks")}
+            "up", "down", "blocked_max", "pressure_ticks", "idle_ticks",
+            "slope_ticks")}
         # streak clocks: monotonic time the current pressure/idle run
         # started (None = the condition does not currently hold)
         self._pressure_since: float | None = None
@@ -121,6 +122,11 @@ class Autoscaler:
             "occupancy": float(rs.get("fleet_in_flight") or 0) / cap,
             "slo_breaches": int(slo.get("breaches") or 0),
             "slo_burn": float(slo.get("burn") or 0.0),
+            # requests/s growth from the router's per-second completion
+            # buckets (router._load_trend) — the PREDICTIVE signal:
+            # positive slope means the load is still climbing toward
+            # whatever will shed, so capacity can start booting now
+            "load_slope": float(rs.get("fleet_load_slope") or 0.0),
         }
 
     # --------------------------------------------------------- decision
@@ -140,13 +146,26 @@ class Autoscaler:
         occ_pressure = sig["occupancy"] >= float(self.fc.autoscale_up_occupancy)
         slo_pressure = (breach_delta > 0 and sig["slo_burn"]
                         >= float(self.fc.autoscale_up_slo_burn))
-        pressure = shed_pressure or occ_pressure or slo_pressure
+        # predictive pressure (ISSUE 16): the load TREND crossed
+        # autoscale_up_slope req/s-per-s — scale while the ramp is still
+        # climbing, before occupancy saturates or the first shed lands.
+        # Disabled (<= 0) keeps the reactive-only r14 policy bit-exact.
+        slope_pressure = (float(self.fc.autoscale_up_slope) > 0
+                          and sig.get("load_slope", 0.0)
+                          >= float(self.fc.autoscale_up_slope))
+        pressure = (shed_pressure or occ_pressure or slo_pressure
+                    or slope_pressure)
         idle = (bad_delta == 0 and sig["occupancy"]
                 <= float(self.fc.autoscale_down_occupancy))
 
         with self._lock:
             if pressure:
                 self._counters["pressure_ticks"] += 1
+                if slope_pressure and not (shed_pressure or occ_pressure
+                                           or slo_pressure):
+                    # the slope ALONE saw it coming: the tick the pool
+                    # moved ahead of the load instead of behind it
+                    self._counters["slope_ticks"] += 1
                 self._idle_since = None
                 if self._pressure_since is None:
                     self._pressure_since = now_m
@@ -164,8 +183,12 @@ class Autoscaler:
             if (self._pressure_since is not None
                     and now_m - self._pressure_since
                     >= float(self.fc.autoscale_up_after_s)):
+                # reactive causes outrank the predictive one in the
+                # label: "load_slope" on a scale record means the pool
+                # grew BEFORE any shed/breach/saturation existed
                 why = ("shed" if shed_pressure
-                       else "slo_burn" if slo_pressure else "occupancy")
+                       else "slo_burn" if slo_pressure
+                       else "occupancy" if occ_pressure else "load_slope")
                 if sig["size"] >= self.max:
                     self._counters["blocked_max"] += 1
                     return None, f"pressure ({why}) but at max_replicas"
@@ -253,6 +276,7 @@ class Autoscaler:
             "fleet_autoscale_blocked_max": c["blocked_max"],
             "fleet_autoscale_pressure_ticks": c["pressure_ticks"],
             "fleet_autoscale_idle_ticks": c["idle_ticks"],
+            "fleet_autoscale_slope_ticks": c["slope_ticks"],
             "fleet_autoscale_last_event_s": (
                 round(time.monotonic() - last, 1)
                 if last is not None else None),

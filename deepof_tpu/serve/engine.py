@@ -437,6 +437,16 @@ class InferenceEngine:
 
             self._ledger = ExecutableLedger(
                 cfg.train.log_dir, backend=jax.default_backend())
+        # artifact plane (serve/artifacts.py): when serve.artifacts_dir
+        # names a store, every lattice entry is FETCHED (deserialized)
+        # from it instead of compiled, keyed by the local lowering's
+        # StableHLO fingerprint — the zero-cold-start replica boot. A
+        # miss/reject falls back to the compile path loudly.
+        self._artifacts = None
+        if not self._forward_custom:
+            from .artifacts import store_for_config
+
+            self._artifacts = store_for_config(cfg)
 
         depth = max(int(cfg.serve.queue_depth), 0)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -1011,13 +1021,25 @@ class InferenceEngine:
         return c
 
     def _compile_recorded(self, name: str, lower_fn):
-        """AOT-compile through the executable ledger when one is active
-        (provenance row: fingerprint, compile seconds, cache hit/miss,
-        cost/memory analysis, donation), else compile bare."""
+        """Resolve one lattice executable: through the executable ledger
+        when one is active (provenance row: fingerprint, compile
+        seconds, cache hit/miss, artifact verdict, cost/memory
+        analysis, donation) — which fetches from the artifact store
+        before compiling — else bare (same fetch-first order, no
+        row)."""
         if self._ledger is not None:
-            compiled, _ = self._ledger.record_aot(name, lower_fn)
+            compiled, _ = self._ledger.record_aot(
+                name, lower_fn, artifacts=self._artifacts)
             return compiled
-        return lower_fn().compile()
+        lowered = lower_fn()
+        if self._artifacts is not None:
+            from ..obs.ledger import fingerprint_text
+
+            compiled, _verdict = self._artifacts.fetch(
+                fingerprint_text(lowered.as_text()))
+            if compiled is not None:
+                return compiled
+        return lowered.compile()
 
     def _score_executable(self, bucket: tuple[int, int]):
         """The bucket's AOT-compiled quality scorer (obs/quality.py) —

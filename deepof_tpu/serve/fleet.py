@@ -208,6 +208,13 @@ class Fleet:
             train=dataclasses.replace(self.cfg.train, log_dir=rdir),
             serve=dataclasses.replace(
                 self.cfg.serve, port=0,
+                # the artifact store rides the config handoff resolved
+                # to an absolute path: a replica's cwd must never decide
+                # which store it boots from (scale-up spawns load
+                # artifacts instead of compiling — ISSUE 16)
+                artifacts_dir=(os.path.abspath(
+                    self.cfg.serve.artifacts_dir)
+                    if self.cfg.serve.artifacts_dir else ""),
                 fleet=dataclasses.replace(self.fc, replicas=0,
                                           autoscale=False)))
         try:

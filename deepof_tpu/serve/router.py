@@ -76,6 +76,13 @@ from ..obs.registry import merge_stats_blocks
 from .buckets import pick_bucket, resolve_buckets
 from .quant import resolve_precisions
 
+#: load-trend window: how many FULL seconds of per-second completion
+#: buckets feed fleet_load_rps / fleet_load_slope (the predictive
+#: autoscaler's signal) — long enough for a least-squares slope to ride
+#: out one noisy second, short enough to see a burst inside the
+#: autoscaler's up_after_s sustain window
+LOAD_WINDOW_S = 10
+
 #: JPEG start-of-frame markers that carry the image dimensions (all SOF
 #: variants; C4/C8/CC are huffman/arithmetic tables, not frames).
 _JPEG_SOF = frozenset(range(0xC0, 0xD0)) - {0xC4, 0xC8, 0xCC}
@@ -162,6 +169,12 @@ class Router:
         # scale events a long-lived fleet sees, and the total stays
         # monotonic
         self._routed_retired = 0
+        # per-second completion buckets (unix second -> 200s landed that
+        # second), the load-trend source for fleet_load_rps /
+        # fleet_load_slope — the predictive autoscaler's slope signal
+        # (serve/autoscale.py, fleet.autoscale_up_slope). Bounded: pruned
+        # past LOAD_WINDOW_S on every insert.
+        self._done_per_s: dict[int, int] = defaultdict(int)
         self._requests = 0
         self._responses = 0
         self._errors = 0
@@ -437,6 +450,7 @@ class Router:
                 if status < 400:
                     self._responses += 1
                     total = self._responses
+                    self._note_done()
                 else:
                     self._errors += 1  # structured client error, relayed
                     total = None
@@ -518,6 +532,7 @@ class Router:
             if status < 400:
                 self._responses += 1
                 total = self._responses
+                self._note_done()
             else:
                 self._errors += 1  # structured client error, relayed
                 total = None
@@ -608,6 +623,34 @@ class Router:
             self._routed_retired += self._routed.pop(idx, 0)
 
     # ------------------------------------------------------------ stats
+    def _note_done(self) -> None:
+        """Bucket one completed (status < 400) request into the current
+        unix second and prune the window. Caller holds self._lock."""
+        s = int(time.time())
+        self._done_per_s[s] += 1
+        if len(self._done_per_s) > LOAD_WINDOW_S + 2:
+            cutoff = s - LOAD_WINDOW_S - 1
+            for k in [k for k in self._done_per_s if k < cutoff]:
+                del self._done_per_s[k]
+
+    def _load_trend(self, now: float) -> tuple[float, float]:
+        """(recent requests/s, req/s-per-second slope) over the last
+        LOAD_WINDOW_S FULL seconds of completion buckets. The current
+        partial second is excluded (its count is still rising and would
+        bias the slope down); absent seconds are zero traffic, so the
+        window zero-fills — a burst arriving after idle slopes steeply,
+        which is exactly the signal the predictive autoscaler wants.
+        Caller holds self._lock."""
+        end = int(now)
+        ys = [float(self._done_per_s.get(s, 0))
+              for s in range(end - LOAD_WINDOW_S, end)]
+        n = len(ys)
+        rps = sum(ys) / n
+        mx = (n - 1) / 2.0
+        denom = sum((i - mx) ** 2 for i in range(n))
+        slope = sum((i - mx) * (y - rps) for i, y in enumerate(ys)) / denom
+        return rps, slope
+
     def in_flight_total(self) -> int:
         with self._lock:
             return sum(self._in_flight.values())
@@ -619,7 +662,10 @@ class Router:
         is set — the fleet SLO state the error budget burns against."""
         hist = self._hist.snapshot()
         with self._lock:
+            rps, slope = self._load_trend(time.time())
             out = {
+                "fleet_load_rps": round(rps, 3),
+                "fleet_load_slope": round(slope, 4),
                 "fleet_requests": self._requests,
                 "fleet_responses": self._responses,
                 "fleet_errors": self._errors,

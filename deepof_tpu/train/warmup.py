@@ -347,9 +347,33 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     # committed-baseline side of the ledger_diff drift gate
     from ..obs.ledger import (ExecutableLedger, exec_name,
                               quality_exec_name)
+    from ..serve.artifacts import store_for_config
 
     ledger = ExecutableLedger(cfg.train.log_dir, enabled=cfg.obs.ledger,
                               backend=jax.default_backend())
+    # artifact plane (serve/artifacts.py): `warmup --serve` is the
+    # SINGLE WRITER — every freshly compiled lattice entry is
+    # serialized + atomically published under its StableHLO
+    # fingerprint, and a re-run against a warm store fetches instead of
+    # compiling (compile_kind "artifact"), which is also the publish
+    # idempotence proof
+    store = store_for_config(cfg)
+
+    def _aot(name, lower_fn):
+        compiled, row = ledger.record_aot(name, lower_fn, artifacts=store)
+        art = None
+        if store is not None:
+            if row["compile_kind"] == "artifact":
+                art = "hit"
+            elif row["fingerprint"]:
+                art = store.publish(
+                    row["fingerprint"], compiled, name=name,
+                    compile_s=row["compile_s"],
+                    meta={"donated_args": row["donated_args"],
+                          "num_args": row["num_args"]})
+            else:
+                art = "error:no_fingerprint"
+        return row, art
     out: dict[str, Any] = {"model": cfg.model, "max_batch": max_batch,
                            "backend": jax.default_backend(),
                            "cache_dir": jax.config.jax_compilation_cache_dir,
@@ -400,7 +424,7 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     if mode == "cold":
                         params_sds, x_sds = serve_avals(
                             cold_tier_sds, bucket, max_batch)
-                        _, row = ledger.record_aot(
+                        row, art = _aot(
                             name,
                             lambda: fwd.lower(params_sds, x_sds))
                     else:
@@ -423,7 +447,7 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                                 f"refinement head grid "
                                 f"{tuple(out_sds.shape[1:3])} != cold "
                                 f"head grid {tuple(prior_hw)}")
-                        _, row = ledger.record_aot(
+                        row, art = _aot(
                             name,
                             lambda: refine_fwd.lower(params_sds, x_sds,
                                                      prior_sds))
@@ -435,14 +459,16 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     # compiled fine, persisted nothing.
                     wrote = bool(_entries() - before_files)
                     persisted = wrote or hits >= 1
-                    out["buckets"].append(
-                        {"bucket": [h, w], "tier": tier, "mode": mode,
-                         "compile_s": row["compile_s"],
-                         "fingerprint": row["fingerprint"],
-                         "persisted": persisted,
-                         "status": ("hit" if hits >= 1
-                                    else "persisted" if wrote
-                                    else "skipped")})
+                    entry = {"bucket": [h, w], "tier": tier, "mode": mode,
+                             "compile_s": row["compile_s"],
+                             "fingerprint": row["fingerprint"],
+                             "persisted": persisted,
+                             "status": ("hit" if hits >= 1
+                                        else "persisted" if wrote
+                                        else "skipped")}
+                    if art is not None:
+                        entry["artifact"] = art
+                    out["buckets"].append(entry)
             if score_jit is not None:
                 # the bucket's quality scorer: flow grid derived from
                 # the DEFAULT tier's cold executable, exactly as
@@ -453,21 +479,33 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                 before_files = _entries()
                 flow_hw = cold_output_hw(fwd, tier0_sds, bucket, max_batch)
                 x_sds, flow_sds = quality_avals(bucket, flow_hw)
-                _, row = ledger.record_aot(
+                row, art = _aot(
                     quality_exec_name(bucket),
                     lambda: score_jit.lower(x_sds, flow_sds))
                 hits = row["cache_hits"] or 0
                 wrote = bool(_entries() - before_files)
                 persisted = wrote or hits >= 1
-                out["buckets"].append(
-                    {"bucket": [h, w], "tier": "-", "mode": "quality",
-                     "compile_s": row["compile_s"],
-                     "fingerprint": row["fingerprint"],
-                     "persisted": persisted,
-                     "status": ("hit" if hits >= 1
-                                else "persisted" if wrote
-                                else "skipped")})
+                entry = {"bucket": [h, w], "tier": "-", "mode": "quality",
+                         "compile_s": row["compile_s"],
+                         "fingerprint": row["fingerprint"],
+                         "persisted": persisted,
+                         "status": ("hit" if hits >= 1
+                                    else "persisted" if wrote
+                                    else "skipped")}
+                if art is not None:
+                    entry["artifact"] = art
+                out["buckets"].append(entry)
     out["cache"] = d.stats()
     out["persisted_buckets"] = sum(b["persisted"] for b in out["buckets"])
     out["skipped_buckets"] = sum(not b["persisted"] for b in out["buckets"])
+    if store is not None:
+        arts = [b.get("artifact") for b in out["buckets"]]
+        out["artifacts"] = {
+            "dir": store.root,
+            "published": sum(1 for a in arts if a == "published"),
+            "exists": sum(1 for a in arts if a == "exists"),
+            "hits": sum(1 for a in arts if a == "hit"),
+            "errors": sum(1 for a in arts
+                          if isinstance(a, str) and a.startswith("error")),
+        }
     return out

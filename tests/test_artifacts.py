@@ -1,0 +1,589 @@
+"""Executable artifact plane tests (DESIGN.md "Artifact plane",
+serve/artifacts.py).
+
+Unit tier: store publish/fetch round-trip with bitwise output parity,
+atomic first-writer-wins publish, the integrity gates (tampered
+manifest, tampered blob, drifted code, backend/version skew — every one
+refuses to load, falls back to compile, and counts), the stdlib-only
+verify/gc half, the jax-free `deepof_tpu artifacts` CLI verb's rc
+contract (0 ok / 1 corrupt / 2 empty — verify-ckpt's convention), and
+ledger_diff treating an artifact load as a non-recompile.
+
+Slow tier: `warmup --serve` publishes the bucket x tier ladder and a
+cold engine boots with ONLY artifact_hit rows, its flows bitwise equal
+to the compile-path engine's on identical requests.
+
+Chaos tier (slow, subprocess): a REAL-model fleet with the store on —
+SIGKILL the scale-up replica mid-boot, the supervisor respawns it, every
+request resolves via failover, and the respawned replica's ledger shows
+it booted from artifacts (zero "aot" rows fleet-wide).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from deepof_tpu.serve.artifacts import (BLOB, MANIFEST, gc_store,
+                                        store_entries, verify_entry,
+                                        verify_store)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- helpers
+
+
+def _store(tmp_path, backend="cpu"):
+    from deepof_tpu.serve.artifacts import ArtifactStore
+
+    return ArtifactStore(str(tmp_path / "exec"), backend=backend)
+
+
+def _ledger(tmp_path, name="run"):
+    from deepof_tpu.obs.ledger import ExecutableLedger
+
+    return ExecutableLedger(str(tmp_path / name), backend="cpu")
+
+
+def _tiny_lower():
+    """A lowering cheap enough for the unit tier: elementwise jit."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x, y: (x @ y + 1.0, y * 2.0))
+    a = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return lambda: f.lower(a, a)
+
+
+def _fake_entry(root: str, fp: str, payload: bytes = b"x" * 64,
+                **manifest_overrides) -> None:
+    """A hand-built store entry (stdlib only — no jax) whose manifest is
+    self-consistent unless an override breaks it on purpose."""
+    d = os.path.join(root, fp)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, BLOB), "wb") as f:
+        f.write(payload)
+    man = {"schema": 1, "fingerprint": fp, "name": "fake",
+           "backend": "cpu", "jax": "0.0.0", "compile_s": 0.1,
+           "created": 123.0,
+           "payload": {"file": BLOB, "size": len(payload),
+                       "crc32": zlib.crc32(payload) & 0xFFFFFFFF}}
+    man.update(manifest_overrides)
+    with open(os.path.join(d, MANIFEST), "w") as f:
+        json.dump(man, f)
+
+
+# ------------------------------------------------------ stdlib half
+
+
+def test_verify_store_and_gc_stdlib_only(tmp_path):
+    """The jax-free half the CLI verb rides: structural verification
+    (schema, fingerprint-vs-dirname, payload size, crc32) and gc of
+    corrupt + abandoned-tmp entries, valid ones kept."""
+    root = str(tmp_path / "exec")
+    _fake_entry(root, "a" * 16)
+    _fake_entry(root, "b" * 16)
+    os.makedirs(os.path.join(root, ".tmp-999-deadbeef"))
+    # corrupt b: flip payload bytes without updating the manifest crc
+    with open(os.path.join(root, "b" * 16, BLOB), "wb") as f:
+        f.write(b"y" * 64)
+
+    rep = verify_store(root)
+    assert rep["total"] == 2 and rep["ok"] == 1
+    assert rep["corrupt"] == ["b" * 16]
+    assert rep["tmp_dirs"] == [".tmp-999-deadbeef"]
+    good = verify_entry(root, "a" * 16)
+    assert good["ok"] and good["name"] == "fake" and good["size"] == 64
+
+    gc = gc_store(root)
+    assert gc["removed"] == ["b" * 16]
+    assert gc["kept"] == ["a" * 16]
+    assert gc["tmp_removed"] == [".tmp-999-deadbeef"]
+    assert store_entries(root) == ["a" * 16]
+
+
+def test_verify_entry_catches_fingerprint_dirname_mismatch(tmp_path):
+    """A manifest whose fingerprint disagrees with its directory name is
+    corrupt — a renamed/copied entry must never verify."""
+    root = str(tmp_path / "exec")
+    _fake_entry(root, "c" * 16, fingerprint="d" * 16)
+    ent = verify_entry(root, "c" * 16)
+    assert not ent["ok"] and "fingerprint" in ent["why"]
+    assert verify_store(root)["corrupt"] == ["c" * 16]
+
+
+def test_gc_older_than_keeps_fresh_valid_entries(tmp_path):
+    root = str(tmp_path / "exec")
+    _fake_entry(root, "e" * 16, created=time.time())
+    _fake_entry(root, "f" * 16, created=time.time() - 40 * 86400)
+    gc = gc_store(root, older_than_days=30)
+    assert gc["removed"] == ["f" * 16]
+    assert gc["kept"] == ["e" * 16]
+
+
+# ------------------------------------------------------- cli verb
+
+
+def _cli(args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable, "-m", "deepof_tpu", "artifacts",
+                           *args], capture_output=True, text=True, env=env,
+                          timeout=60)
+
+
+def test_cli_artifacts_rc_contract(tmp_path):
+    """`deepof_tpu artifacts` mirrors verify-ckpt's rc ladder: 2 on an
+    empty store, 1 when any entry is corrupt, 0 when all verify; gc
+    reports what it removed and exits 0. The verb is jax-free — it must
+    answer fast even where jax can't import."""
+    root = str(tmp_path / "exec")
+    os.makedirs(root)
+    r = _cli(["list", "--dir", root])
+    assert r.returncode == 2 and "empty store" in r.stderr
+
+    _fake_entry(root, "a" * 16)
+    r = _cli(["verify", "--dir", root])
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["total"] == 1 and rep["ok"] == 1 and not rep["corrupt"]
+
+    with open(os.path.join(root, "a" * 16, BLOB), "ab") as f:
+        f.write(b"junk")
+    r = _cli(["verify", "--dir", root])
+    assert r.returncode == 1
+    assert json.loads(r.stdout)["corrupt"] == ["a" * 16]
+
+    r = _cli(["gc", "--dir", root])
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["removed"] == ["a" * 16]
+    r = _cli(["list", "--dir", root])
+    assert r.returncode == 2
+
+
+# ------------------------------------------------- store round-trip
+
+
+def test_publish_fetch_roundtrip_bitwise_parity(tmp_path):
+    """The tentpole's core loop: record_aot publishes nothing itself —
+    the store's publish/fetch round-trips a serialized executable whose
+    outputs are BITWISE equal to the in-process compile's, the hit is
+    ledgered as compile_kind="artifact" + cache_verdict="artifact_hit",
+    and the artifact row's resolve_s (fetch+deserialize) is what the
+    acquisition figures are built from."""
+    from deepof_tpu.obs.ledger import ROW_KEYS
+
+    store = _store(tmp_path)
+    lower = _tiny_lower()
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", lower, artifacts=store)
+    assert row["compile_kind"] == "aot"
+    assert tuple(row.keys()) == ROW_KEYS
+    assert store.publish(row["fingerprint"], compiled,
+                         name="demo") == "published"
+    # first-writer-wins: a second publish is a no-op, not a corruption
+    assert store.publish(row["fingerprint"], compiled) == "exists"
+
+    led2 = _ledger(tmp_path, "b")
+    c2, row2 = led2.record_aot("demo", lower, artifacts=store)
+    assert row2["compile_kind"] == "artifact"
+    assert row2["cache_verdict"] == "artifact_hit"
+    assert row2["resolve_s"] is not None
+    st = led2.stats()
+    assert st["exec_artifact_hits"] == 1
+    assert st["exec_artifact_misses"] == 0
+
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 8).astype(np.float32)
+    for a, b in zip(compiled(x, y), c2(x, y)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_tampered_blob_and_manifest_refuse_to_load(tmp_path, capsys):
+    """Both tamper axes: a crc-broken blob and a fingerprint-forged
+    manifest each REJECT (loud stderr warn), fall back to compile, and
+    count in exec_artifact_rejects — a stale artifact can never load."""
+    store = _store(tmp_path)
+    lower = _tiny_lower()
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", lower, artifacts=store)
+    fp = row["fingerprint"]
+    store.publish(fp, compiled)
+
+    blob = os.path.join(store.root, fp, BLOB)
+    data = open(blob, "rb").read()
+    with open(blob, "wb") as f:
+        f.write(data[:-4] + b"XXXX")
+    led2 = _ledger(tmp_path, "b")
+    c2, row2 = led2.record_aot("demo", lower, artifacts=store)
+    assert row2["compile_kind"] == "aot"  # fell back to compile
+    assert led2.stats()["exec_artifact_rejects"] == 1
+    assert "REJECT" in capsys.readouterr().err
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    assert np.isfinite(np.asarray(c2(x, x)[0])).all()  # run completes
+
+    with open(blob, "wb") as f:
+        f.write(data)  # restore the blob, forge the manifest instead
+    man_path = os.path.join(store.root, fp, MANIFEST)
+    man = json.load(open(man_path))
+    man["fingerprint"] = "0" * 16
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    led3 = _ledger(tmp_path, "c")
+    _, row3 = led3.record_aot("demo", lower, artifacts=store)
+    assert row3["compile_kind"] == "aot"
+    assert led3.stats()["exec_artifact_rejects"] == 1
+
+
+def test_drifted_code_misses_and_falls_back(tmp_path):
+    """The integrity gate is the fingerprint recomputed from the LOCAL
+    lowering: code drift changes the fingerprint, so the stale artifact
+    is simply never looked up — a miss, a compile, a counted fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    store = _store(tmp_path)
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", _tiny_lower(), artifacts=store)
+    store.publish(row["fingerprint"], compiled)
+
+    drifted = jax.jit(lambda x, y: (x @ y + 2.0, y * 2.0))  # the "edit"
+    a = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    led2 = _ledger(tmp_path, "b")
+    _, row2 = led2.record_aot("demo", lambda: drifted.lower(a, a),
+                              artifacts=store)
+    assert row2["compile_kind"] == "aot"
+    assert row2["fingerprint"] != row["fingerprint"]
+    assert led2.stats()["exec_artifact_misses"] == 1
+    assert led2.stats()["exec_artifact_hits"] == 0
+
+
+def test_backend_skew_rejects(tmp_path):
+    """An artifact serialized for another backend must refuse to load
+    even when the fingerprint matches (the StableHLO is backend-neutral;
+    the serialized executable is NOT)."""
+    store = _store(tmp_path)
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", _tiny_lower(), artifacts=store)
+    fp = row["fingerprint"]
+    store.publish(fp, compiled)
+    man_path = os.path.join(store.root, fp, MANIFEST)
+    man = json.load(open(man_path))
+    man["backend"] = "tpu"
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    got, verdict = store.fetch(fp)
+    assert got is None and verdict.startswith("reject:")
+
+
+def test_store_for_config_resolves_path_and_off_switch(tmp_path):
+    """serve.artifacts_dir="" keeps the plane off (None store — the
+    pre-r16 behavior byte-identical); a relative path resolves to an
+    absolute root so replica cwd never decides which store boots."""
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.artifacts import store_for_config
+
+    cfg = get_config("flyingchairs")
+    assert store_for_config(cfg) is None
+    cwd = os.getcwd()
+    try:
+        os.chdir(tmp_path)
+        cfg2 = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, artifacts_dir="rel/exec"))
+        store = store_for_config(cfg2)
+        assert os.path.isabs(store.root)
+        assert store.root == os.path.join(str(tmp_path), "rel", "exec")
+    finally:
+        os.chdir(cwd)
+
+
+# -------------------------------------------------- ledger provenance
+
+
+def test_ledger_diff_artifact_load_is_not_a_recompile(tmp_path):
+    """The r15 sentinel must not rc-8 a replica that booted from the
+    store: the baseline's cache-hit row vs a live artifact row (zero
+    persistent-cache activity) is a FETCH, not a recompile."""
+    from deepof_tpu.obs.ledger import diff_ledgers, lowering_row
+
+    base = lowering_row("serve_64x64_f32", compile_s=1.0,
+                        compile_kind="aot",
+                        cache={"requests": 1, "hits": 1, "misses": 0})
+    live = lowering_row("serve_64x64_f32", compile_s=0.01,
+                        compile_kind="artifact",
+                        cache={"requests": 1, "hits": 0, "misses": 1},
+                        cache_verdict="artifact_hit")
+    rep = diff_ledgers([base], [live])
+    assert rep["unexpected_recompiles"] == []
+    assert not rep["failed"], rep
+
+    # control: the same cache shape WITHOUT the artifact kind still
+    # trips the sentinel — the guard is the kind, not a blanket skip
+    live_miss = lowering_row("serve_64x64_f32", compile_s=1.0,
+                             compile_kind="aot",
+                             cache={"requests": 1, "hits": 0, "misses": 1})
+    rep2 = diff_ledgers([base], [live_miss])
+    assert rep2["unexpected_recompiles"], rep2
+
+
+# --------------------------------------------------- slow: full ladder
+
+
+@pytest.mark.slow
+def test_warmup_publishes_ladder_then_cold_engine_boots_from_store(
+        tmp_path):
+    """The tentpole acceptance, in-process: `warmup --serve` publishes
+    the full bucket x tier ladder into the store (single writer), a
+    cold engine (cleared jax caches) warms with ONLY artifact hits —
+    zero compiles — and serves flows BITWISE equal to a compile-path
+    engine's on identical requests at the same bucket/tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.engine import InferenceEngine, build_serve_model
+    from deepof_tpu.train import warmup
+
+    buckets = ((32, 64),)
+    tiers = ("f32", "bf16")
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(cfg.serve, max_batch=2,
+                                  batch_timeout_ms=40.0, buckets=buckets,
+                                  precisions=tiers,
+                                  artifacts_dir=str(tmp_path / "exec")),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(tmp_path / "run")))
+
+    rep = warmup.warmup_serve(cfg)
+    ladder = len(buckets) * len(tiers)
+    assert rep["artifacts"]["published"] == ladder
+    assert rep["artifacts"]["errors"] == 0
+    assert all(b["artifact"] == "published" for b in rep["buckets"])
+    assert verify_store(str(tmp_path / "exec"))["ok"] == ladder
+
+    model = build_serve_model(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 64, 6)))["params"]
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(1, 255, (30, 60, 3), dtype=np.uint8),
+             rng.randint(1, 255, (30, 60, 3), dtype=np.uint8), t)
+            for t in tiers]
+
+    jax.clear_caches()  # the cold scaled-up replica
+    with InferenceEngine(cfg, model_params=(model, params)) as eng:
+        eng.warm()
+        st = eng.stats()
+        assert st["exec_artifact_hits"] >= ladder, st
+        assert st["exec_artifact_misses"] == 0, st
+        assert st["exec_artifact_rejects"] == 0, st
+        flows_art = [eng.submit(p, n, precision=t).result(timeout=300)
+                     ["flow"] for p, n, t in reqs]
+    # ledger provenance: the cold boot wrote ONLY artifact rows
+    kinds = [json.loads(line).get("compile_kind")
+             for line in open(tmp_path / "run" / "ledger.jsonl")]
+    assert kinds.count("artifact") >= ladder
+    # the publish pass wrote the "aot" rows; the cold boot none
+    assert kinds.count("aot") == ladder
+
+    jax.clear_caches()  # the compile-path control engine
+    cfg_off = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    artifacts_dir=""))
+    with InferenceEngine(cfg_off, model_params=(model, params)) as eng:
+        eng.warm()
+        flows_cmp = [eng.submit(p, n, precision=t).result(timeout=300)
+                     ["flow"] for p, n, t in reqs]
+    for fa, fc in zip(flows_art, flows_cmp):
+        assert fa.dtype == fc.dtype
+        assert (fa == fc).all(), "artifact executable diverged bitwise"
+
+
+# ----------------------------------------------- slow chaos: the drill
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_chaos_scale_up_sigkill_respawns_from_artifacts(tmp_path):
+    """The fleet drill with the store ON and REAL-model replicas:
+    publish the ladder, checkpoint the params, start a 1-replica fleet,
+    drive load, scale up, SIGKILL the new replica mid-boot. The
+    supervisor respawns it, 100% of requests resolve via failover to
+    the surviving replica, and the respawned replica's ledger proves it
+    booted from artifacts — zero "aot" rows anywhere in the fleet."""
+    import base64
+
+    import jax
+    import jax.numpy as jnp
+
+    cv2 = pytest.importorskip("cv2")
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.engine import build_serve_model
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+    from deepof_tpu.train import warmup
+    from deepof_tpu.train.checkpoint import CheckpointManager
+    from deepof_tpu.train.schedule import step_decay_schedule
+    from deepof_tpu.train.state import create_train_state, make_optimizer
+
+    fleet_dir = tmp_path / "fleet"
+    store_dir = str(tmp_path / "exec")
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(
+            cfg.serve, max_batch=2, batch_timeout_ms=20.0,
+            buckets=((32, 64),), precisions=("f32",),
+            fake_exec_ms=None,  # REAL replicas: the artifact plane's case
+            host="127.0.0.1", port=0, artifacts_dir=store_dir,
+            fleet=dataclasses.replace(
+                cfg.serve.fleet, poll_s=0.2, stale_after_s=10.0,
+                spawn_timeout_s=180.0, term_grace_s=1.0, backoff_s=0.2,
+                backoff_max_s=1.0, healthy_after_s=60.0,
+                proxy_timeout_s=30.0, max_in_flight=16,
+                drain_timeout_s=2.0)),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(fleet_dir)),
+        obs=dataclasses.replace(cfg.obs, heartbeat_period_s=0.2,
+                                watchdog_min_s=3600.0))
+
+    # single-writer publish (the `warmup --serve` leg)
+    pub_cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, log_dir=str(tmp_path / "publish")))
+    rep = warmup.warmup_serve(pub_cfg)
+    assert rep["artifacts"]["published"] >= 1
+
+    # the checkpoint every replica restores (restore_params' template)
+    model = build_serve_model(cfg)
+    tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
+    for idx in range(3):  # pre-seed replica dirs with the shared ckpt
+        rdir = fleet_dir / f"replica-{idx}"
+        rdir.mkdir(parents=True, exist_ok=True)
+        if idx == 0:
+            state = create_train_state(model, jnp.zeros((1, 32, 64, 6)),
+                                       tx, seed=0)
+            mgr = CheckpointManager(str(rdir / "ckpt"), async_save=False)
+            mgr.save(state)
+            mgr.finalize()
+        else:
+            os.symlink(str(fleet_dir / "replica-0" / "ckpt"),
+                       str(rdir / "ckpt"))
+
+    def _body(rng):
+        imgs = []
+        for _ in range(2):
+            ok, buf = cv2.imencode(".png", rng.randint(
+                1, 255, (30, 60, 3), dtype=np.uint8))
+            assert ok
+            imgs.append(base64.b64encode(buf.tobytes()).decode())
+        return json.dumps({"prev": imgs[0], "next": imgs[1]}).encode()
+
+    rng = np.random.RandomState(0)
+    bodies = [_body(rng) for _ in range(4)]
+    outcomes: list = []
+    lock = threading.Lock()
+
+    def _post(port, body):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", "/v1/flow", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    with Fleet(cfg, 1) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=1, timeout_s=180)
+        router = Router(cfg, fleet)
+        fleet.on_retired = router.retire_slot
+        httpd = build_router_server(cfg, router)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        stop = threading.Event()
+
+        def _load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    status, payload = _post(port, bodies[i % len(bodies)])
+                except Exception as e:  # noqa: BLE001 - a drop is a bug
+                    status, payload = -1, str(e).encode()
+                with lock:
+                    outcomes.append((status, payload))
+                i += 1
+
+        loader = threading.Thread(target=_load, daemon=True)
+        loader.start()
+        try:
+            new_idx = fleet.scale_up()
+            assert new_idx is not None
+            # SIGKILL the scale-up replica mid-boot (before ready)
+            deadline = time.monotonic() + 60
+            killed = False
+            while time.monotonic() < deadline and not killed:
+                for d in fleet.describe():
+                    if d["replica"] == new_idx and d["pid"]:
+                        try:
+                            os.kill(d["pid"], 9)
+                            killed = True
+                        except OSError:
+                            pass
+                        break
+                if not killed:
+                    time.sleep(0.05)
+            assert killed, fleet.describe()
+            # the supervisor respawns it and it reaches ready
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                if fleet.stats()["fleet_ready"] >= 2:
+                    break
+                time.sleep(0.2)
+            stats = fleet.stats()
+            assert stats["fleet_ready"] >= 2, stats
+            assert stats["fleet_crashes"] + stats["fleet_respawns"] >= 1, \
+                stats
+            time.sleep(1.0)  # a beat of load on the respawned replica
+        finally:
+            stop.set()
+            loader.join(timeout=30)
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+
+    # 100% resolution: every request got a structured response (the
+    # survivor absorbed the kill window via failover)
+    assert outcomes
+    bad = [(s, p[:120]) for s, p in outcomes if s != 200]
+    assert not bad, (len(outcomes), bad[:5])
+
+    # the respawned replica booted from artifacts: its ledger has
+    # artifact rows and the whole fleet compiled NOTHING
+    new_ledger = fleet_dir / f"replica-{new_idx}" / "ledger.jsonl"
+    kinds = [json.loads(line).get("compile_kind")
+             for line in open(new_ledger)]
+    assert kinds.count("artifact") >= 1, kinds
+    for rdir in sorted(fleet_dir.glob("replica-*")):
+        lp = rdir / "ledger.jsonl"
+        if lp.exists():
+            for line in open(lp):
+                assert json.loads(line).get("compile_kind") != "aot", \
+                    f"{rdir.name} compiled instead of fetching"

@@ -68,6 +68,20 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
     ("bench_ramp_sheds_after_scale",
      ("serve_bench_ramp", "sheds_after_scale"), "lower"),
     ("bench_ramp_drops", ("serve_bench_ramp", "drops"), "lower"),
+    # r16 zero-cold-start serving: how long a scaled-up replica takes
+    # to serve (spawn -> first admitted request), the sheds the
+    # predictive load-slope signal pre-empted vs reactive-only on the
+    # same ramped drive, and the artifact plane's two cold-start
+    # figures — end-to-end warm wall (bounded by the trace/lower floor
+    # on a cpu host) and the isolated compile-vs-fetch acquisition step
+    ("bench_ramp_scale_up_first_response_ms",
+     ("serve_bench_ramp", "scale_up_to_first_response_ms"), "lower"),
+    ("bench_ramp_predictive_shed_delta",
+     ("serve_bench_ramp", "predictive_shed_delta"), "higher"),
+    ("bench_artifact_cold_start_speedup",
+     ("serve_bench_artifact", "cold_start_speedup"), "higher"),
+    ("bench_artifact_acquire_speedup",
+     ("serve_bench_artifact", "acquire_speedup"), "higher"),
     # r15 executable ledger (obs/ledger.py + serve_bench
     # --ledger-overhead): hot-path cost of ledgering (bounded <= 2%),
     # total lattice compile seconds, and the measured-vs-nominal-

@@ -103,7 +103,25 @@ RAMP_REQUIRED_KEYS = (
     "requests", "requests_per_s", "errors", "drops", "sheds_burst",
     "sheds_after_scale", "scale_ups", "scale_downs", "retired",
     "evictions", "peak_replicas", "final_replicas", "scale_up_latency_s",
+    "scale_up_to_first_response_ms", "predictive_sheds", "reactive_sheds",
+    "predictive_shed_delta", "autoscale_up_slope",
     "wall_s", "max_batch", "fake_exec_ms", "max_in_flight",
+)
+
+#: keys every --artifact-cold result carries (schema smoke test): the
+#: r16 zero-cold-start acceptance A/B — one `warmup --serve` publish
+#: into the executable artifact store, then the SAME cold engine warm
+#: twice (jax caches cleared between legs): once compile-bound (store
+#: off) and once fetching the published artifacts. `cold_start_speedup`
+#: is the executable-acquisition win; the artifact leg must show
+#: ladder-many `artifact_hits` and zero misses/rejects or the store is
+#: not actually serving the boot.
+ARTIFACT_COLD_REQUIRED_KEYS = (
+    "mode", "model", "width_mult", "bucket", "tiers", "ladder",
+    "publish_wall_s", "publish_compile_s", "warm_wall_compile_s",
+    "warm_wall_artifact_s", "cold_start_speedup", "acquire_compile_s",
+    "acquire_fetch_s", "acquire_speedup", "artifact_hits",
+    "artifact_misses", "artifact_rejects", "store_entries", "store_bytes",
 )
 
 #: keys every --stream result carries (schema smoke test). The warm_*
@@ -1018,10 +1036,11 @@ def _drive_timed(port: int, body: bytes, clients: int,
 
 def _ramp_cfg(log_dir: str, max_replicas: int, max_batch: int,
               timeout_ms: float, exec_ms: float, max_in_flight: int,
-              bucket: tuple[int, int]):
+              bucket: tuple[int, int], slope: float = 0.0):
     """Fast-cadence autoscaling fleet config: sub-second control loop,
     short sustain windows/cooldowns — the same policy shape as
-    production, compressed so a bench run finishes in tens of seconds."""
+    production, compressed so a bench run finishes in tens of seconds.
+    `slope` > 0 arms the predictive load-slope scale-up signal."""
     import dataclasses as dc
 
     cfg = _fleet_cfg(log_dir, max_batch, timeout_ms, exec_ms, bucket)
@@ -1034,7 +1053,60 @@ def _ramp_cfg(log_dir: str, max_replicas: int, max_batch: int,
                          autoscale_up_after_s=0.5,
                          autoscale_down_after_s=2.0,
                          autoscale_up_cooldown_s=1.0,
-                         autoscale_down_cooldown_s=2.0)))
+                         autoscale_down_cooldown_s=2.0,
+                         autoscale_up_slope=slope)))
+
+
+def _slope_leg(base: str, slope: float, max_replicas: int, max_batch: int,
+               timeout_ms: float, exec_ms: float, max_in_flight: int,
+               bucket: tuple[int, int], body: bytes,
+               burst_clients: int, step_s: float = 1.0) -> dict:
+    """One predictive-vs-reactive compare leg: a FRESH 1-replica
+    autoscaling fleet under an incrementally ramped closed-loop drive
+    (1 -> burst_clients clients, one more per `step_s`) — the load shape
+    where a positive completions/s slope is visible BEFORE occupancy or
+    sheds are. With `slope` armed the pool scales on the trend; with
+    slope 0 it scales only after the reactive pressure sustains. The
+    leg's shed count is the figure the delta is built from."""
+    from deepof_tpu.serve.autoscale import Autoscaler
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+
+    cfg = _ramp_cfg(base, max_replicas, max_batch, timeout_ms, exec_ms,
+                    max_in_flight, bucket, slope=slope)
+    fc = cfg.serve.fleet
+    out = {"ok": 0, "errors": 0, "drops": 0}
+    with Fleet(cfg) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=1, timeout_s=fc.spawn_timeout_s)
+        router = Router(cfg, fleet)
+        fleet.on_retired = router.retire_slot
+        httpd = build_router_server(cfg, router)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        scaler = Autoscaler(cfg, fleet, router)
+        router.autoscale_stats = scaler.stats
+        scaler.start()
+        try:
+            for clients in range(1, burst_clients + 1):
+                chunk = _drive_timed(port, body, clients, step_s)
+                for k in ("ok", "errors", "drops"):
+                    out[k] += chunk[k]
+            rs = router.stats()
+            ss = scaler.stats()
+            out.update({
+                "slope": slope,
+                "sheds": rs["fleet_shed"] + rs["fleet_unavailable"],
+                "scale_ups": ss["fleet_autoscale_up"],
+                "slope_ticks": ss.get("fleet_autoscale_slope_ticks", 0),
+                "final_replicas": fleet.size,
+            })
+        finally:
+            scaler.close()
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+    return out
 
 
 def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
@@ -1043,6 +1115,7 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
                timeout_ms: float = 2.0, exec_ms: float = 30.0,
                max_in_flight: int = 4, bucket: tuple[int, int] = (32, 64),
                native_hw: tuple[int, int] = (30, 60),
+               slope_threshold: float = 2.0,
                log_dir: str | None = None) -> dict:
     """Bursty-load autoscaler exercise, end to end and in-process
     (Fleet + Router + Autoscaler, fake-executor replica subprocesses):
@@ -1065,7 +1138,17 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
     drops counts transport-level no-response failures across ALL
     phases — the zero-silent-drops ledger; scale events ride the
     router's /metrics scrape (`metrics_scrape`) exactly as an
-    operator's collector would see them."""
+    operator's collector would see them.
+
+    Two r16 figures ride the result: `scale_up_to_first_response_ms`
+    (first scale-up event -> first request ADMITTED to the scaled-up
+    replica, watched at 20 ms off the router's per-replica routed
+    counters; the fake executor completes within one exec quantum of
+    admission) and the predictive-vs-reactive compare — two extra
+    fresh-fleet legs under an incrementally ramped drive, one with the
+    load-slope signal armed at `slope_threshold`, one reactive-only;
+    `predictive_shed_delta` = reactive sheds - predictive sheds, the
+    sheds the trend signal pre-empted."""
     import tempfile
 
     from deepof_tpu.serve.autoscale import Autoscaler
@@ -1103,6 +1186,31 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
 
             phases["warm"] = _drive_timed(port, body, 1, warm_s)
 
+            # spawn -> first response: watch the router's per-replica
+            # routed counters for the first request admitted to a
+            # replica that did not exist before the burst (admission is
+            # within one exec quantum of its 200 — the fake executor
+            # never fails a routed request here)
+            baseline_names = set(router.stats()["fleet_routed"])
+            first_new_resp: list[float | None] = [None]
+            watch_stop = threading.Event()
+
+            def _watch_first_response() -> None:
+                while not watch_stop.is_set():
+                    try:
+                        routed = router.stats()["fleet_routed"]
+                    except Exception:  # noqa: BLE001 - watcher must not raise
+                        return
+                    for rname, n in routed.items():
+                        if rname not in baseline_names and n > 0:
+                            first_new_resp[0] = time.time()
+                            return
+                    time.sleep(0.02)
+
+            watcher = threading.Thread(target=_watch_first_response,
+                                       daemon=True)
+            watcher.start()
+
             shed0 = shed_now()
             t_burst_wall = time.time()
             phases["burst"] = _drive_timed(port, body, burst_clients,
@@ -1129,6 +1237,8 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
                     hold[k] += chunk[k]
             hold["t1"] = round(time.time(), 2)
             phases["hold"] = hold
+            watch_stop.set()
+            watcher.join(timeout=1.0)
             peak = max(peak, fleet.size)
             up_events = scaler.stats()["fleet_autoscale_up"]
             first_up = None
@@ -1174,6 +1284,16 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
             router.draining = True
             httpd.shutdown()
             httpd.server_close()
+    # predictive-vs-reactive: two fresh fleets under the SAME ramped
+    # drive — slope armed vs reactive-only. Run after the main drill so
+    # its fleet is fully torn down (ports, subprocesses) first.
+    predictive = _slope_leg(os.path.join(base, "leg_predictive"),
+                            slope_threshold, max_replicas, max_batch,
+                            timeout_ms, exec_ms, max_in_flight, bucket,
+                            body, burst_clients)
+    reactive = _slope_leg(os.path.join(base, "leg_reactive"), 0.0,
+                          max_replicas, max_batch, timeout_ms, exec_ms,
+                          max_in_flight, bucket, body, burst_clients)
     wall = time.perf_counter() - t_start
 
     total = {k: sum(p[k] for p in phases.values())
@@ -1199,11 +1319,150 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
         "final_replicas": fstats["fleet_replicas"],
         "scale_up_latency_s": (round(first_up - t_burst_wall, 2)
                                if first_up else None),
+        "scale_up_to_first_response_ms": (
+            round((first_new_resp[0] - first_up) * 1000.0, 1)
+            if first_up and first_new_resp[0] else None),
+        "predictive_sheds": predictive["sheds"],
+        "reactive_sheds": reactive["sheds"],
+        "predictive_shed_delta": reactive["sheds"] - predictive["sheds"],
+        "autoscale_up_slope": slope_threshold,
+        "compare_legs": {"predictive": predictive, "reactive": reactive},
         "wall_s": round(wall, 2),
         "max_batch": max_batch, "fake_exec_ms": exec_ms,
         "max_in_flight": max_in_flight, "bucket": list(bucket),
         "log_dir": base,
         "metrics_scrape": scrape,
+    }
+
+
+# ---------------------------------------------------- artifact cold start
+
+
+def artifact_cold_bench(model: str = "flownet_s", width_mult: float = 1.0,
+                        bucket: tuple[int, int] = (64, 128),
+                        tiers: tuple[str, ...] = ("f32",),
+                        log_dir: str | None = None) -> dict:
+    """The r16 zero-cold-start acceptance A/B, in one process:
+
+      publish  `warmup --serve` AOT-compiles the bucket x tier ladder
+               and publishes each executable into the artifact store
+               (the single-writer leg — this wall is paid ONCE, not per
+               replica).
+      leg A    jax caches cleared, engine with the store OFF: warm()
+               is compile-bound — every ladder entry traces, lowers,
+               and XLA-compiles. This is what every scaled-up replica
+               paid before the artifact plane.
+      leg B    jax caches cleared again, engine with the store ON:
+               warm() traces + lowers (the fingerprint integrity gate
+               needs the local lowering) then fetches + deserializes —
+               zero compiles, asserted via the engine's
+               exec_artifact_* counters.
+
+    Two figures, honestly separated: `cold_start_speedup` = leg A wall
+    / leg B wall — the end-to-end warm win, which on a CPU host is
+    bounded by the trace+lower floor BOTH legs pay (the fingerprint
+    integrity gate recomputes the local lowering either way);
+    `acquire_speedup` = mean "aot" row compile_s / mean "artifact" row
+    compile_s from the legs' ledger provenance — the isolated
+    executable-acquisition step the store replaces (XLA compile vs
+    fetch+deserialize), the figure that scales with device compile
+    walls. Defaults to the flagship-width flownet_s; the tiny bench
+    model would understate both."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.serve.artifacts import store_entries, verify_entry
+    from deepof_tpu.serve.engine import build_serve_model
+    from deepof_tpu.train import warmup
+
+    base = log_dir or tempfile.mkdtemp(prefix="serve_bench_artifact_")
+    store_dir = os.path.join(base, "exec")
+    cfg = _bench_cfg(bucket, 2, 40.0, os.path.join(base, "run"))
+    cfg = cfg.replace(
+        model=model, width_mult=width_mult,
+        serve=dataclasses.replace(cfg.serve, buckets=(bucket,),
+                                  precisions=tuple(tiers),
+                                  artifacts_dir=store_dir))
+
+    t0 = time.perf_counter()
+    rep = warmup.warmup_serve(cfg)
+    publish_wall = time.perf_counter() - t0
+    ladder = len(rep["buckets"])
+    publish_compile = round(sum(b.get("compile_s") or 0.0
+                                for b in rep["buckets"]), 3)
+
+    model_obj = build_serve_model(cfg)
+    params = model_obj.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, *bucket, 3 * cfg.data.time_step)))["params"]
+
+    # leg A: compile-bound cold start (store off)
+    jax.clear_caches()
+    cfg_off = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    artifacts_dir=""))
+    t0 = time.perf_counter()
+    with InferenceEngine(cfg_off, model_params=(model_obj, params)) as eng:
+        eng.warm()
+    t_compile = time.perf_counter() - t0
+
+    # leg B: artifact cold start (store on)
+    jax.clear_caches()
+    t0 = time.perf_counter()
+    with InferenceEngine(cfg, model_params=(model_obj, params)) as eng:
+        eng.warm()
+        st = eng.stats()
+    t_artifact = time.perf_counter() - t0
+
+    fps = store_entries(store_dir)
+    store_bytes = sum(verify_entry(store_dir, fp).get("size") or 0
+                      for fp in fps)
+
+    # per-step acquisition split from the ledger provenance rows the
+    # two legs just appended: resolve_s is the resolution step alone —
+    # XLA compile on an "aot" row, fingerprint+fetch+deserialize on an
+    # "artifact" row — with the trace/lower floor both legs pay (the
+    # fingerprint integrity gate needs the local lowering either way)
+    # excluded
+    acquire_compile = []
+    acquire_fetch = []
+    try:
+        with open(os.path.join(base, "run", "ledger.jsonl")) as f:
+            for line in f:
+                row = json.loads(line)
+                if row.get("resolve_s") is None:
+                    continue
+                if row.get("compile_kind") == "artifact":
+                    acquire_fetch.append(row["resolve_s"])
+                elif row.get("compile_kind") == "aot":
+                    acquire_compile.append(row["resolve_s"])
+    except (OSError, ValueError):
+        pass
+    acq_c = (round(sum(acquire_compile) / len(acquire_compile), 4)
+             if acquire_compile else None)
+    acq_f = (round(sum(acquire_fetch) / len(acquire_fetch), 4)
+             if acquire_fetch else None)
+    return {
+        "mode": "artifact_cold_start", "model": model,
+        "width_mult": width_mult, "bucket": list(bucket),
+        "tiers": list(tiers), "ladder": ladder,
+        "publish_wall_s": round(publish_wall, 2),
+        "publish_compile_s": publish_compile,
+        "warm_wall_compile_s": round(t_compile, 2),
+        "warm_wall_artifact_s": round(t_artifact, 2),
+        "cold_start_speedup": round(t_compile / max(t_artifact, 1e-9), 2),
+        "acquire_compile_s": acq_c,
+        "acquire_fetch_s": acq_f,
+        "acquire_speedup": (round(acq_c / max(acq_f, 1e-9), 1)
+                            if acq_c is not None and acq_f is not None
+                            else None),
+        "artifact_hits": st.get("exec_artifact_hits", 0),
+        "artifact_misses": st.get("exec_artifact_misses", 0),
+        "artifact_rejects": st.get("exec_artifact_rejects", 0),
+        "store_entries": len(fps), "store_bytes": store_bytes,
+        "store_dir": store_dir, "log_dir": base,
+        "warmup_artifacts": rep.get("artifacts"),
     }
 
 
@@ -1252,6 +1511,19 @@ def main(argv=None) -> int:
                     help="ramp mode: seconds per burst phase")
     ap.add_argument("--idle-s", type=float, default=20.0,
                     help="ramp mode: idle window for the scale-down leg")
+    ap.add_argument("--slope", type=float, default=2.0,
+                    help="ramp mode: autoscale_up_slope threshold armed "
+                         "in the predictive compare leg (completions/s "
+                         "trend per second)")
+    ap.add_argument("--artifact-cold", action="store_true",
+                    help="r16 zero-cold-start A/B: publish the ladder "
+                         "into the executable artifact store, then time "
+                         "a cold engine warm compile-bound vs artifact-"
+                         "fetching (cold_start_speedup, artifact_hits)")
+    ap.add_argument("--width-mult", type=float, default=1.0,
+                    help="artifact-cold mode: model width (default the "
+                         "flagship 1.0 — compile-dominated, the shape "
+                         "the artifact win is real on)")
     ap.add_argument("--stream", action="store_true",
                     help="benchmark the streaming video-session API: a "
                          "closed-loop session walk vs the equivalent "
@@ -1313,7 +1585,14 @@ def main(argv=None) -> int:
     args.exec_ms, args.timeout_ms = exec_ms, timeout_ms
     args.max_batch = user_batch if user_batch is not None else 8
 
-    if args.ramp:
+    if args.artifact_cold:
+        res = artifact_cold_bench(
+            width_mult=args.width_mult, bucket=hw(args.bucket),
+            tiers=(tuple(t.strip() for t in args.precision.split(",")
+                         if t.strip())
+                   if args.precision is not None else ("f32",)),
+            log_dir=args.log_dir)
+    elif args.ramp:
         # explicit flags pass through; absent ones keep the ramp's own
         # tuned defaults (exec 30 ms / flush 2 ms / batch 2 — the shed-
         # then-absorb dynamics the drill and BENCH figures are built on)
@@ -1327,6 +1606,7 @@ def main(argv=None) -> int:
                          timeout_ms=user_timeout if user_timeout is not None
                          else 2.0,
                          bucket=hw(args.bucket), native_hw=hw(args.native),
+                         slope_threshold=args.slope,
                          log_dir=args.log_dir)
     elif args.stream:
         res = stream_bench(frames=args.frames, decode_ms=args.decode_ms,
