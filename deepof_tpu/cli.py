@@ -324,6 +324,26 @@ def main(argv=None) -> int:
                             "fingerprint + payload size/crc32); gc: "
                             "remove corrupt entries and orphaned tmp "
                             "staging dirs")
+    p_art.add_argument("--deep", action="store_true",
+                       help="verify only: re-lower every indexed serve "
+                            "executable under the given config and "
+                            "compare StableHLO fingerprints against the "
+                            "store's index (the offline twin of the "
+                            "engine's background deep-verify plane). "
+                            "Needs jax + the config the index was "
+                            "published under (--preset/--model/--set); "
+                            "rc 1 on drift, rc 2 on an empty/unindexed "
+                            "store")
+    p_art.add_argument("--preset", default="flyingchairs",
+                       choices=sorted(PRESETS),
+                       help="--deep only: config preset the index was "
+                            "published under")
+    p_art.add_argument("--model", default=None,
+                       help="--deep only: model override")
+    p_art.add_argument("--set", action="append",
+                       metavar="SECTION.FIELD=VALUE",
+                       help="--deep only: config overrides (must match "
+                            "the publishing warmup's)")
     p_art.add_argument("--dir", default=None,
                        help="store root (default: <repo>/artifacts/exec, "
                             "serve.artifacts_dir's conventional home)")
@@ -465,6 +485,30 @@ def main(argv=None) -> int:
                                       verify_store)
 
         root = args.dir or DEFAULT_STORE_DIR
+        if args.action == "verify" and args.deep:
+            # the one artifacts action that DOES need jax: re-lower the
+            # serve lattice under the given config and compare StableHLO
+            # fingerprints against the index — catches code drift the
+            # structural (crc/manifest) verify cannot see
+            from .train.warmup import deep_verify_serve
+
+            args.data_path = None  # _build_cfg expects the common args
+            args.log_dir = None
+            cfg = _build_cfg(args)
+            cfg = _apply_override(cfg, "serve.artifacts_dir", repr(root))
+            try:
+                report = deep_verify_serve(cfg)
+            except ValueError as e:
+                print(f"artifacts verify --deep: {e}", file=sys.stderr)
+                return 2
+            print(json.dumps(report, indent=args.json_indent))
+            if report["drift"]:
+                return 1
+            if not report["entries"] or report["ok"] == 0:
+                print(f"artifacts: nothing indexed to deep-verify at "
+                      f"{root!r}", file=sys.stderr)
+                return 2
+            return 0
         if args.action == "gc":
             report = gc_store(root, older_than_days=args.older_than_days)
             print(json.dumps(report, indent=args.json_indent))

@@ -397,6 +397,14 @@ class FleetConfig:
     # graceful shutdown: stop admission, wait this long for in-flight
     # requests to flush before reaping replicas
     drain_timeout_s: float = 10.0
+    # Artifact-store GC on the retirement path (ROADMAP item 5b): every
+    # graceful replica retirement and fleet close sweeps the store —
+    # corrupt entries and orphaned tmp staging always go; entries older
+    # than this many days also go UNLESS pinned (the index's targets
+    # and every fingerprint a live replica's ledger recorded are always
+    # roots, so a sweep can never collect an executable the lattice
+    # boots from). <= 0 keeps the sweep corrupt/tmp-only (no age-out).
+    artifacts_gc_days: float = 0.0
     # --- SLO-driven autoscaler (serve/autoscale.py, DESIGN.md
     # "Supervision plane"): the fixed `--replicas N` pool becomes a
     # load-follower between min_replicas and max_replicas, scaling up on
@@ -550,6 +558,24 @@ class ServeConfig:
     # the parent->replica config.json handoff, so fleet children and
     # autoscale spawns boot from the same store.
     artifacts_dir: str = ""
+    # Trace-free boot through the store's executable index (index.json):
+    # the engine resolves each lattice executable by its jax-free
+    # resolution key — (exec name, config digest, aval signature,
+    # backend, jax version) — with ZERO trace/lower calls; any index
+    # miss/reject falls back to the fingerprint-then-compile path.
+    # False = ignore the index (the r16 fingerprint-keyed boot, which
+    # still pays one trace+lower per executable; serve_bench's A/B leg
+    # uses this to measure the index's win). No effect when
+    # artifacts_dir is empty.
+    artifacts_index: bool = True
+    # Deferred deep-verify plane: after an index-resolved executable
+    # starts serving, a background verifier re-lowers it and compares
+    # StableHLO fingerprints; on mismatch the executable is loudly
+    # demoted (exec_deep_verify_demoted counter + warn record) and a
+    # freshly compiled one is swapped in. False = trust the index +
+    # crc gates alone (offline audits remain available via
+    # `deepof_tpu artifacts verify --deep`).
+    artifacts_deep_verify: bool = True
     # Streaming video sessions (serve/session.py): POST /v1/flow/stream
     # keeps the last frame per session so consecutive pairs cost one
     # decode, not two; the router pins each session to one replica.

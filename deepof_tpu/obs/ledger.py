@@ -195,7 +195,8 @@ def lowering_row(name: str, lowered=None, compiled=None,
                  compile_kind: str | None = None,
                  cache: dict | None = None,
                  cache_verdict: str | None = None,
-                 backend: str | None = None) -> dict:
+                 backend: str | None = None,
+                 fingerprint: str | None = None) -> dict:
     """One ledger row for a lowering. `lowered` (jax.stages.Lowered)
     supplies the fingerprint, cost analysis, and donation map;
     `compiled` (jax.stages.Compiled) supplies memory_analysis — pass
@@ -204,14 +205,20 @@ def lowering_row(name: str, lowered=None, compiled=None,
     second XLA compile just to fill them. `compile_kind` says what
     compile_s MEASURES — "aot" (pure lower+compile, record_aot),
     "first_step" (the train loop's first-step wall: compile + one
-    executed step), or "artifact" (lower + fetch/deserialize from the
-    artifact store, NO compile at all) — so diff_ledgers never compares
+    executed step), "artifact" (fetch/deserialize from the artifact
+    store, NO compile at all), or "deep_verify" (the background
+    verifier's post-serve re-lowering) — so diff_ledgers never compares
     the units. `cache_verdict` names where the executable came from:
-    explicit "artifact_hit" from the artifact plane, else derived from
-    the persistent-cache delta ("hit"/"miss"), else None."""
+    explicit "artifact_hit" / "index_hit" from the artifact plane, else
+    derived from the persistent-cache delta ("hit"/"miss"), else None.
+    `fingerprint` sets the row's fingerprint when there is no local
+    Lowered to hash (an index-resolved row carries the INDEX's claimed
+    fingerprint — what the deep-verify plane later re-checks); it is
+    ignored when `lowered` is passed."""
     row: dict[str, Any] = {k: None for k in ROW_KEYS}
     row.update({"kind": "exec", "schema": LEDGER_SCHEMA, "name": name,
-                "time": round(time.time(), 3), "backend": backend})
+                "time": round(time.time(), 3), "backend": backend,
+                "fingerprint": fingerprint})
     if compile_s is not None:
         row["compile_s"] = round(float(compile_s), 4)
         row["compile_kind"] = compile_kind
@@ -303,6 +310,20 @@ class ExecutableLedger:
         self._artifact_hits = 0
         self._artifact_misses = 0
         self._artifact_rejects = 0
+        # executable-index accounting (trace-free resolution):
+        # hits = executables resolved with zero trace/lower calls,
+        # misses = no index entry for the key (lowering path taken),
+        # rejects = entry present but failed a trust gate (forged,
+        # cross-wired, stale target, version skew) — loud fallback
+        self._index_hits = 0
+        self._index_misses = 0
+        self._index_rejects = 0
+        # deferred deep-verify plane: pending = index-resolved entries
+        # awaiting background re-lowering, ok = fingerprint confirmed,
+        # demoted = mismatch -> executable swapped for a fresh compile
+        self._deep_verify_pending = 0
+        self._deep_verify_ok = 0
+        self._deep_verify_demoted = 0
         # per-executable measured execution time: name -> [count, total_s,
         # roofline_s] — MFU = roofline / mean measured, re-derived at
         # stats() time, never merged (registry kind: derived)
@@ -325,7 +346,8 @@ class ExecutableLedger:
                resolve_s: float | None = None,
                compile_kind: str | None = None,
                cache: dict | None = None,
-               cache_verdict: str | None = None) -> dict:
+               cache_verdict: str | None = None,
+               fingerprint: str | None = None) -> dict:
         """Build, count, and append one lowering row (see lowering_row).
         Returns the row so call sites can fold the fingerprint into
         their own reports (the warmup CLI report does)."""
@@ -333,7 +355,7 @@ class ExecutableLedger:
                            compile_s=compile_s, resolve_s=resolve_s,
                            compile_kind=compile_kind,
                            cache=cache, cache_verdict=cache_verdict,
-                           backend=self.backend)
+                           backend=self.backend, fingerprint=fingerprint)
         with self._lock:
             self._lowerings += 1
             if compile_s is not None:
@@ -405,6 +427,56 @@ class ExecutableLedger:
                           cache_verdict="artifact_hit" if hit else None)
         return compiled, row
 
+    def record_index(self, name: str, artifacts, key: str) -> Any:
+        """The trace-free resolution helper: resolve `key` through the
+        store's executable index (serve/artifacts.py ``resolve`` —
+        zero trace/lower calls on every path) and, on a hit, record the
+        ``cache_verdict="index_hit"`` row: compile_kind "artifact"
+        (resolve_s = compile_s = pure fetch+deserialize wall, and
+        diff_ledgers already treats "artifact" rows as non-recompiles),
+        fingerprint = the INDEX's claimed fingerprint (there is no
+        local Lowered to hash — the deep-verify plane re-checks it
+        after serving starts), cost/memory provenance read off the
+        deserialized executable. A miss or reject records nothing and
+        returns (None, None, verdict): the caller falls back to the
+        lowering path, which writes its own row. Returns
+        (compiled | None, row | None, verdict)."""
+        t0 = time.perf_counter()
+        try:
+            compiled, fp, verdict = artifacts.resolve(key)
+        except Exception:  # noqa: BLE001 - index is best-effort
+            compiled, fp, verdict = None, None, "index_reject:resolve_failed"
+        dt = time.perf_counter() - t0
+        with self._lock:
+            if verdict == "index_hit":
+                self._index_hits += 1
+                self._deep_verify_pending += 1
+            elif verdict == "index_miss":
+                self._index_misses += 1
+            else:
+                self._index_rejects += 1
+        if compiled is None:
+            return None, None, verdict
+        row = self.record(name, lowered=None, compiled=compiled,
+                          compile_s=dt, resolve_s=dt,
+                          compile_kind="artifact",
+                          cache_verdict="index_hit",
+                          fingerprint=fp)
+        return compiled, row, verdict
+
+    def note_deep_verify(self, ok: bool) -> None:
+        """One background deep-verify outcome: confirmed (ok) or
+        demoted (the index's fingerprint does not match what local code
+        lowers to — the executable was swapped for a fresh compile).
+        Either way one pending slot drains."""
+        with self._lock:
+            self._deep_verify_pending = max(
+                0, self._deep_verify_pending - 1)
+            if ok:
+                self._deep_verify_ok += 1
+            else:
+                self._deep_verify_demoted += 1
+
     def note_exec(self, name: str, seconds: float) -> None:
         """Accumulate one measured execution of `name` (the serve
         engine's flush timer feeds this; training MFU rides the
@@ -431,6 +503,12 @@ class ExecutableLedger:
                 "exec_artifact_hits": self._artifact_hits,
                 "exec_artifact_misses": self._artifact_misses,
                 "exec_artifact_rejects": self._artifact_rejects,
+                "exec_index_hits": self._index_hits,
+                "exec_index_misses": self._index_misses,
+                "exec_index_rejects": self._index_rejects,
+                "exec_deep_verify_pending": self._deep_verify_pending,
+                "exec_deep_verify_ok": self._deep_verify_ok,
+                "exec_deep_verify_demoted": self._deep_verify_demoted,
                 "exec_executables": len(self._fingerprints),
                 "exec_fingerprints": dict(self._fingerprints),
                 "exec_dispatches": sum(e[0] for e in self._exec.values()),
@@ -599,12 +677,16 @@ def diff_ledgers(baseline: list[dict], run: list[dict],
                             cache hit but this run's missed — a silent
                             cold-start regression (cache key drift,
                             evicted cache, version skew). Rows whose
-                            compile_kind is "artifact" (either side)
-                            never enter this check: an artifact load
-                            is a FETCH, not a compile, so its zero
-                            cache activity is healthy, not a miss —
-                            no spurious rc 8 from booting off the
-                            artifact plane
+                            compile_kind is "artifact" (fingerprint- or
+                            index-resolved fetches, including
+                            cache_verdict="index_hit" rows) or
+                            "deep_verify" (the background verifier's
+                            post-serve re-lowering) never enter this
+                            check on either side: a fetch is not a
+                            compile and a deep verify is not a boot, so
+                            their cache activity is healthy, not a
+                            miss — no spurious rc 8 from booting off
+                            the artifact plane
       compile_blowups       compile_s exceeded
                             max(compile_floor_s, baseline * factor) —
                             compared ONLY between rows whose
@@ -630,8 +712,8 @@ def diff_ledgers(baseline: list[dict], run: list[dict],
         bf, rf = b.get("fingerprint"), r.get("fingerprint")
         if bf and rf and bf != rf:
             drift.append({"name": name, "baseline": bf, "run": rf})
-        if (b.get("compile_kind") != "artifact"
-                and r.get("compile_kind") != "artifact"
+        if (b.get("compile_kind") not in ("artifact", "deep_verify")
+                and r.get("compile_kind") not in ("artifact", "deep_verify")
                 and (b.get("cache_hits") or 0) >= 1
                 and (b.get("cache_misses") or 0) == 0
                 and (r.get("cache_misses") or 0) >= 1):

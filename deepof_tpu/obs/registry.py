@@ -208,7 +208,22 @@ _ENTRIES: list[Key] = [
            # (misses) vs compiled because an entry failed an integrity
            # gate (rejects — always loud)
            "exec_artifact_hits", "exec_artifact_misses",
-           "exec_artifact_rejects"),
+           "exec_artifact_rejects",
+           # executable index (trace-free boot): executables resolved
+           # by jax-free key with zero trace/lower calls (hits) vs no
+           # index entry for the key (misses — lowering path taken) vs
+           # entry failed a trust gate: forged, cross-wired, stale
+           # target, version skew (rejects — always loud)
+           "exec_index_hits", "exec_index_misses", "exec_index_rejects",
+           # deferred deep-verify plane: background re-lowerings that
+           # confirmed an index-resolved executable (ok) vs loudly
+           # demoted it — fingerprint mismatch, fresh compile swapped
+           # in (demoted). Summing across a fleet stays honest: each
+           # replica verifies its own boots exactly once.
+           "exec_deep_verify_ok", "exec_deep_verify_demoted"),
+    # index-resolved executables still awaiting their background
+    # re-lowering; a fleet sum is the pool's total unverified count
+    Key("exec_deep_verify_pending", "sum", "ledger"),
     Key("exec_executables", "gauge", "ledger"),
     Key("exec_fingerprints", "state", "ledger"),
     Key("exec_mfu_nominal", "derived", "ledger"),

@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import itertools
 import queue
+import sys
 import threading
 import time
 from collections import deque
@@ -257,6 +258,17 @@ def cold_output_hw(cold_fwd, cold_params, bucket: tuple[int, int],
     params_sds, x_sds = serve_avals(cold_params, bucket, max_batch)
     out = jax.eval_shape(cold_fwd, params_sds, x_sds)
     return (int(out.shape[1]), int(out.shape[2]))
+
+
+def _lowered_out_hw(lowered) -> tuple[int, int]:
+    """The (h, w) grid of a lowering's (single-array) output, read off
+    ``Lowered.out_info`` — the shape the trace ALREADY derived, so the
+    prior-grid check costs zero additional traces (it formerly paid a
+    full eval_shape of the refine forward per warm lattice entry)."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(lowered.out_info)[0]
+    return (int(leaf.shape[1]), int(leaf.shape[2]))
 
 
 def refine_serve_avals(refine_params, bucket: tuple[int, int],
@@ -447,6 +459,31 @@ class InferenceEngine:
             from .artifacts import store_for_config
 
             self._artifacts = store_for_config(cfg)
+        # executable index (trace-free boot): resolve each lattice entry
+        # by its jax-free resolution key BEFORE building avals or
+        # lowering anything — an index hit is fetch + gates +
+        # deserialize, zero trace/lower calls. Integrity beyond the
+        # crc/manifest/name gates is deferred to the deep-verify plane
+        # below; any index miss/reject falls through to the
+        # fingerprint-then-compile path.
+        self._index_enabled = (self._artifacts is not None
+                               and bool(cfg.serve.artifacts_index))
+        self._deep_verify_enabled = (self._index_enabled
+                                     and bool(
+                                         cfg.serve.artifacts_deep_verify))
+        self._cfg_digest: str | None = None
+        # deferred deep-verify plane: every index-resolved entry is
+        # queued for a background re-lowering AFTER it starts serving;
+        # a fingerprint mismatch loudly demotes it (counter + warn +
+        # freshly compiled swap-in under _compile_lock). Lazily started
+        # daemon thread; close() stops it.
+        self._deep_verify_q: queue.Queue = queue.Queue()
+        self._deep_verify_thread: threading.Thread | None = None
+        # cold-head output grid per bucket (one eval_shape each, shared
+        # by every tier's warm entry and the bucket's quality scorer —
+        # the grid is dtype-independent, so re-deriving it per tier was
+        # pure duplicated tracing)
+        self._cold_hw: dict[tuple[int, int], tuple[int, int]] = {}
 
         depth = max(int(cfg.serve.queue_depth), 0)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
@@ -981,44 +1018,73 @@ class InferenceEngine:
             c = self._compiled.get(key)
             if c is None:
                 bucket, tier, mode = key
-                if mode == "warm":
-                    import jax
-
-                    prior_hw = cold_output_hw(
-                        self._jit, self._params_by_tier[tier], bucket,
-                        self.max_batch)
-                    params_sds, x_sds, prior_sds = refine_serve_avals(
-                        self._refine_by_tier[tier], bucket,
-                        self.max_batch, prior_hw)
-                    # the prior chain must be shape-stable: after the
-                    # first warm step the stored prior is the REFINE
-                    # stage's output, so its grid must equal the cold
-                    # head grid the executable was lowered for — check
-                    # abstractly HERE (warm()/first use), not as a
-                    # poisoned dispatch three steps in
-                    out_sds = jax.eval_shape(self._warm_jit, params_sds,
-                                             x_sds, prior_sds)
-                    if tuple(out_sds.shape[1:3]) != tuple(prior_hw):
-                        raise ValueError(
-                            f"warm_start unsupported for model "
-                            f"{self.cfg.model!r} at bucket {bucket}: the "
-                            f"refinement head grid "
-                            f"{tuple(out_sds.shape[1:3])} differs from "
-                            f"the cold head grid {tuple(prior_hw)} — the "
-                            f"session's prior would change shape after "
-                            f"the first warm step")
-                    c = self._compile_recorded(
-                        exec_name(bucket, tier, mode),
-                        lambda: self._warm_jit.lower(params_sds, x_sds,
-                                                     prior_sds))
-                else:
-                    params_sds, x_sds = serve_avals(
-                        self._params_by_tier[tier], bucket, self.max_batch)
-                    c = self._compile_recorded(
-                        exec_name(bucket, tier, mode),
-                        lambda: self._jit.lower(params_sds, x_sds))
+                name = exec_name(bucket, tier, mode)
+                # trace-free resolution first: an index hit skips the
+                # aval construction AND the cold_output_hw eval_shape
+                # below — the entire lattice can resolve with zero
+                # trace/lower calls (the acceptance contract ISSUE 17
+                # proves from the ledger's index_hit rows)
+                c = self._resolve_index(name, serve_key=key)
+                if c is None:
+                    c = self._lower_and_compile(key)
                 self._compiled[key] = c
         return c
+
+    def _lower_and_compile(self, key: tuple[tuple[int, int], str, str]):
+        """The lowering path (index off / miss / reject / demote):
+        build avals, lower ONCE, and resolve via fingerprint fetch or
+        compile. One `lowered` object per lattice entry is shared
+        across the prior-grid check, the fingerprint, the ledger row,
+        and the compile — the warm path's former eval_shape of the
+        refine forward (a second full trace per entry) is replaced by
+        reading the grid off the lowering's own out_info."""
+        bucket, tier, mode = key
+        if mode == "warm":
+            prior_hw = self._cold_head_hw(bucket)
+            params_sds, x_sds, prior_sds = refine_serve_avals(
+                self._refine_by_tier[tier], bucket,
+                self.max_batch, prior_hw)
+
+            def lower_checked():
+                lowered = self._warm_jit.lower(params_sds, x_sds,
+                                               prior_sds)
+                # the prior chain must be shape-stable: after the
+                # first warm step the stored prior is the REFINE
+                # stage's output, so its grid must equal the cold
+                # head grid the executable was lowered for — check
+                # abstractly HERE (warm()/first use), not as a
+                # poisoned dispatch three steps in
+                out_hw = _lowered_out_hw(lowered)
+                if out_hw != tuple(prior_hw):
+                    raise ValueError(
+                        f"warm_start unsupported for model "
+                        f"{self.cfg.model!r} at bucket {bucket}: the "
+                        f"refinement head grid {out_hw} differs from "
+                        f"the cold head grid {tuple(prior_hw)} — the "
+                        f"session's prior would change shape after "
+                        f"the first warm step")
+                return lowered
+
+            return self._compile_recorded(exec_name(bucket, tier, mode),
+                                          lower_checked)
+        params_sds, x_sds = serve_avals(
+            self._params_by_tier[tier], bucket, self.max_batch)
+        return self._compile_recorded(
+            exec_name(bucket, tier, mode),
+            lambda: self._jit.lower(params_sds, x_sds))
+
+    def _cold_head_hw(self, bucket: tuple[int, int]) -> tuple[int, int]:
+        """The cold network's output grid at `bucket` — ONE eval_shape
+        per bucket, cached: every tier's warm entry and the bucket's
+        quality scorer share it (the grid does not depend on the weight
+        dtype), where each formerly paid its own trace."""
+        hw = self._cold_hw.get(bucket)
+        if hw is None:
+            hw = tuple(cold_output_hw(
+                self._jit, self._params_by_tier[self.default_tier],
+                bucket, self.max_batch))
+            self._cold_hw[bucket] = hw
+        return hw
 
     def _compile_recorded(self, name: str, lower_fn):
         """Resolve one lattice executable: through the executable ledger
@@ -1041,26 +1107,196 @@ class InferenceEngine:
                 return compiled
         return lowered.compile()
 
+    # ------------------------------------------- trace-free index boot
+    def _config_digest(self) -> str:
+        if self._cfg_digest is None:
+            from .artifacts import serve_config_digest
+
+            self._cfg_digest = serve_config_digest(self.cfg)
+        return self._cfg_digest
+
+    def _index_key(self, name: str,
+                   serve_key: tuple | None = None,
+                   quality_bucket: tuple | None = None) -> str:
+        """The entry's jax-free resolution key. The aval signature
+        reads shapes/dtypes off the CONCRETE in-memory param trees (no
+        trace); `warmup --serve` computes the identical signature from
+        its eval_shape trees, so both sides agree without either
+        re-lowering. Warm entries sign the refine tree — the prior
+        aval is derived state the index entry carries (`prior_hw`),
+        validated at publish time and re-checked by deep verify."""
+        import jax
+
+        from .artifacts import params_aval_sig, resolution_key
+
+        if serve_key is not None:
+            bucket, tier, mode = serve_key
+            params = (self._refine_by_tier[tier] if mode == "warm"
+                      else self._params_by_tier[tier])
+        else:
+            bucket = quality_bucket
+            params = self._params_by_tier[self.default_tier]
+        x_aval = ("__x__",
+                  (self.max_batch, bucket[0], bucket[1], PAIR_CHANNELS),
+                  "float32")
+        sig = params_aval_sig(params, extra=(x_aval,))
+        return resolution_key(name, self._config_digest(), sig,
+                              jax.default_backend(), jax.__version__)
+
+    def _resolve_index(self, name: str, serve_key: tuple | None = None,
+                       quality_bucket: tuple | None = None):
+        """Trace-free resolution of one lattice entry through the
+        store's executable index: key lookup + trust gates + fetch +
+        deserialize, zero trace/lower calls. A hit is recorded as a
+        ``cache_verdict="index_hit"`` ledger row and queued for the
+        deferred deep-verify plane; every miss/reject returns None and
+        the caller falls back to the lowering path (whose own row —
+        `aot`/`artifact` — is the loud evidence on `tail`)."""
+        if not self._index_enabled:
+            return None
+        key = self._index_key(name, serve_key=serve_key,
+                              quality_bucket=quality_bucket)
+        if self._ledger is not None:
+            compiled, _row, verdict = self._ledger.record_index(
+                name, self._artifacts, key)
+        else:
+            try:
+                compiled, _fp, verdict = self._artifacts.resolve(key)
+            except Exception:  # noqa: BLE001 - index is best-effort
+                compiled, verdict = None, "index_reject:resolve_failed"
+        if compiled is None:
+            return None
+        ent = self._artifacts.index_entry(key) or {}
+        if self._deep_verify_enabled:
+            self._schedule_deep_verify(
+                name, serve_key, quality_bucket,
+                ent.get("fingerprint"))
+        return compiled
+
+    # ------------------------------------------- deferred deep verify
+    def _schedule_deep_verify(self, name, serve_key, quality_bucket,
+                              expected_fp) -> None:
+        """Queue one index-resolved entry for background re-lowering.
+        Caller holds _compile_lock; the worker itself never takes it
+        except for the swap-in, so verification cannot stall a boot."""
+        self._deep_verify_q.put((name, serve_key, quality_bucket,
+                                 expected_fp))
+        if self._deep_verify_thread is None:
+            t = threading.Thread(target=self._deep_verify_loop,
+                                 name="deep-verify", daemon=True)
+            self._deep_verify_thread = t
+            t.start()
+
+    def _deep_verify_loop(self) -> None:
+        while True:
+            item = self._deep_verify_q.get()
+            if item is None:
+                self._deep_verify_q.task_done()
+                return
+            try:
+                self._deep_verify_one(*item)
+            except Exception as e:  # noqa: BLE001 - verify best-effort
+                print(f"serve: deep-verify {item[0]} failed: {e}",
+                      file=sys.stderr)
+                if self._ledger is not None:
+                    self._ledger.note_deep_verify(True)
+            finally:
+                self._deep_verify_q.task_done()
+
+    def _deep_verify_one(self, name, serve_key, quality_bucket,
+                         expected_fp) -> None:
+        """Re-lower one index-resolved entry and compare StableHLO
+        fingerprints. Match -> exec_deep_verify_ok. Mismatch (the
+        index's claimed lowering is NOT what local code produces —
+        code drift against a stale index) -> loud demote: warn on
+        stderr, exec_deep_verify_demoted counter, a
+        compile_kind="deep_verify" ledger row carrying the TRUE
+        fingerprint, and a freshly compiled executable swapped in
+        under _compile_lock. Serving never pauses; at worst a few
+        dispatches ride the stale-but-crc-intact executable before the
+        swap lands."""
+        import time as _time
+
+        from ..obs.ledger import fingerprint_text
+
+        t0 = _time.perf_counter()
+        if serve_key is not None:
+            bucket, tier, mode = serve_key
+            if mode == "warm":
+                prior_hw = self._cold_head_hw(bucket)
+                params_sds, x_sds, prior_sds = refine_serve_avals(
+                    self._refine_by_tier[tier], bucket,
+                    self.max_batch, prior_hw)
+                lowered = self._warm_jit.lower(params_sds, x_sds,
+                                               prior_sds)
+            else:
+                params_sds, x_sds = serve_avals(
+                    self._params_by_tier[tier], bucket, self.max_batch)
+                lowered = self._jit.lower(params_sds, x_sds)
+        else:
+            flow_hw = self._cold_head_hw(quality_bucket)
+            x_sds, flow_sds = quality_avals(quality_bucket, flow_hw)
+            lowered = self._score_jit.lower(x_sds, flow_sds)
+        fp = fingerprint_text(lowered.as_text())
+        ok = fp == expected_fp
+        if ok:
+            if self._ledger is not None:
+                self._ledger.note_deep_verify(True)
+                self._ledger.record(
+                    name, lowered=lowered,
+                    compile_s=_time.perf_counter() - t0,
+                    compile_kind="deep_verify",
+                    cache_verdict="deep_verify_ok")
+            return
+        print(f"serve: DEEP-VERIFY DEMOTE {name}: index claimed "
+              f"{expected_fp}, local code lowers to {fp} — swapping in "
+              f"a fresh compile", file=sys.stderr)
+        compiled = lowered.compile()
+        with self._compile_lock:
+            if serve_key is not None:
+                self._compiled[serve_key] = compiled
+            else:
+                self._score_compiled[quality_bucket] = compiled
+        if self._ledger is not None:
+            self._ledger.note_deep_verify(False)
+            self._ledger.record(
+                name, lowered=lowered, compiled=compiled,
+                compile_s=_time.perf_counter() - t0,
+                compile_kind="deep_verify",
+                cache_verdict="deep_verify_demoted")
+
+    def deep_verify_join(self, timeout_s: float = 60.0) -> bool:
+        """Wait until every queued deep verification has completed
+        (tests and offline drills; serving never calls this). True when
+        the queue drained within the timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._deep_verify_q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return self._deep_verify_q.unfinished_tasks == 0
+
     def _score_executable(self, bucket: tuple[int, int]):
         """The bucket's AOT-compiled quality scorer (obs/quality.py) —
         ONE executable per bucket (tiers and modes share it: the scorer
         consumes f32 inputs and f32 flow regardless of the tier that
-        produced them), compiled (or loaded from the persistent cache —
-        the `warmup --serve` contract) on first use. Lock-free fast
-        path on hit, same double-checked pattern as _executable."""
+        produced them), resolved index-first like the serve lattice,
+        compiled on first use otherwise. Lock-free fast path on hit,
+        same double-checked pattern as _executable."""
         c = self._score_compiled.get(bucket)
         if c is not None:
             return c
         with self._compile_lock:
             c = self._score_compiled.get(bucket)
             if c is None:
-                flow_hw = cold_output_hw(
-                    self._jit, self._params_by_tier[self.default_tier],
-                    bucket, self.max_batch)
-                x_sds, flow_sds = quality_avals(bucket, flow_hw)
-                c = self._compile_recorded(
-                    quality_exec_name(bucket),
-                    lambda: self._score_jit.lower(x_sds, flow_sds))
+                name = quality_exec_name(bucket)
+                c = self._resolve_index(name, quality_bucket=bucket)
+                if c is None:
+                    flow_hw = self._cold_head_hw(bucket)
+                    x_sds, flow_sds = quality_avals(bucket, flow_hw)
+                    c = self._compile_recorded(
+                        name,
+                        lambda: self._score_jit.lower(x_sds, flow_sds))
                 self._score_compiled[bucket] = c
         return c
 
@@ -1207,6 +1443,12 @@ class InferenceEngine:
         # consuming at this point).
         self._q.put(_STOP)
         self._thread.join(timeout=60.0)
+        if self._deep_verify_thread is not None:
+            # stop the verifier before the ledger flush: an in-progress
+            # verification finishes (its row lands), queued-but-unstarted
+            # ones stay pending (visible as exec_deep_verify_pending)
+            self._deep_verify_q.put(None)
+            self._deep_verify_thread.join(timeout=30.0)
         if self._ledger is not None:
             # after the batcher join: every flush's note_exec has landed,
             # so the exec_timing rows carry the full run's measurements

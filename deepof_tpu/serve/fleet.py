@@ -467,6 +467,59 @@ class Fleet:
         except OSError:
             pass
 
+    # -------------------------------------------------- artifact GC
+    def _live_fingerprints(self) -> set[str]:
+        """Every fingerprint any replica's ledger recorded this run —
+        the live lattice, read stdlib-only off the replica dirs'
+        ledger.jsonl (the supervisor never lowers anything itself)."""
+        from ..obs.ledger import load_ledger
+
+        with self._lock:
+            dirs = [self._replica_dir(r) for r in self._replicas]
+        fps: set[str] = set()
+        for d in dirs:
+            try:
+                rows = load_ledger(d)
+            except OSError:
+                continue
+            for row in rows:
+                fp = row.get("fingerprint")
+                if isinstance(fp, str) and fp:
+                    fps.add(fp)
+        return fps
+
+    def _artifacts_gc(self, trigger: str) -> None:
+        """Bounded store GC on the retirement path (ROADMAP item 5b):
+        graceful retirement / fleet close sweeps corrupt entries and
+        orphaned tmp staging, plus (fleet.artifacts_gc_days > 0)
+        unpinned entries older than the bound. The live lattice's
+        fingerprints (every replica ledger's rows) are passed as roots
+        and gc_store itself pins the index's targets, so the sweep can
+        never collect an executable a replica is serving or the next
+        boot would index-resolve. Best-effort: a GC failure never
+        blocks retirement."""
+        root = getattr(self.cfg.serve, "artifacts_dir", "")
+        if not root:
+            return
+        try:
+            from .artifacts import gc_store
+
+            days = float(getattr(self.fc, "artifacts_gc_days", 0.0))
+            rep = gc_store(os.path.abspath(os.path.expanduser(root)),
+                           older_than_days=(days if days > 0 else None),
+                           roots=self._live_fingerprints())
+            if rep["removed"] or rep["tmp_removed"]:
+                rec = {"kind": "warn", "step": 0, "time": time.time(),
+                       "message": (f"fleet artifacts gc ({trigger}): "
+                                   f"removed {len(rep['removed'])} "
+                                   f"entries, {len(rep['tmp_removed'])} "
+                                   f"tmp, kept {len(rep['kept'])}")}
+                with open(os.path.join(self.dir, "metrics.jsonl"),
+                          "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+        except Exception:  # noqa: BLE001 - gc must not block retirement
+            pass
+
     # ------------------------------------------------------- router API
     def ready_replicas(self) -> list:
         """Immutable (idx, port) snapshots of replicas safe to route to."""
@@ -563,6 +616,7 @@ class Fleet:
                 hook(r.idx)  # router ages out the slot's maps
             except Exception:  # noqa: BLE001 - aging must not kill scaling
                 pass
+        self._artifacts_gc("retire")
         return r.idx
 
     # ------------------------------------------------------------ stats
@@ -636,6 +690,10 @@ class Fleet:
                 if r.state != "retired":
                     r.state = "stopped"
                 r.port = None
+        # after every replica is down: the close-time store sweep (same
+        # roots discipline as retire-time GC; replicas' ledgers are
+        # complete now, so the pin set is the whole run's lattice)
+        self._artifacts_gc("close")
 
     def __enter__(self) -> "Fleet":
         return self

@@ -26,6 +26,8 @@ r03->r04 host change, benign feature-hint warning).
 from __future__ import annotations
 
 import os
+import sys
+import time
 from typing import Any
 
 import jax
@@ -317,10 +319,11 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
 
     from ..obs.quality import make_score_fn, quality_avals
     from ..serve.buckets import resolve_buckets
-    from ..serve.engine import (PAIR_CHANNELS, build_refine_model,
-                                build_serve_model, cold_output_hw,
-                                make_raw_forward, make_refine_forward,
-                                refine_serve_avals, serve_avals)
+    from ..serve.engine import (PAIR_CHANNELS, _lowered_out_hw,
+                                build_refine_model, build_serve_model,
+                                cold_output_hw, make_raw_forward,
+                                make_refine_forward, refine_serve_avals,
+                                serve_avals)
     from ..serve.quant import quantize_params, resolve_precisions
 
     enable_for_config(cfg)
@@ -347,7 +350,9 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     # committed-baseline side of the ledger_diff drift gate
     from ..obs.ledger import (ExecutableLedger, exec_name,
                               quality_exec_name)
-    from ..serve.artifacts import store_for_config
+    from ..serve.artifacts import (params_aval_sig, resolution_key,
+                                   serve_config_digest, store_for_config,
+                                   write_index)
 
     ledger = ExecutableLedger(cfg.train.log_dir, enabled=cfg.obs.ledger,
                               backend=jax.default_backend())
@@ -356,8 +361,38 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
     # serialized + atomically published under its StableHLO
     # fingerprint, and a re-run against a warm store fetches instead of
     # compiling (compile_kind "artifact"), which is also the publish
-    # idempotence proof
+    # idempotence proof. Next to the per-fingerprint entries it
+    # publishes the executable INDEX (atomic-rename index.json): each
+    # entry's jax-free resolution key -> the fingerprint this run
+    # lowered, so a later engine/replica boots the whole lattice with
+    # zero trace/lower calls (serve/engine.py `_resolve_index`).
     store = store_for_config(cfg)
+    cfg_digest = serve_config_digest(cfg) if store is not None else None
+    index_entries: dict[str, dict] = {}
+
+    def _index(name, row, art, params_sds, bucket, extra_meta=None):
+        """Stage one index entry: only executables that are actually IN
+        the store (fresh publish, prior entry, or fingerprint hit) get
+        indexed — an index entry whose target is absent would be a
+        stale-target reject at every boot."""
+        if store is None or not row["fingerprint"]:
+            return
+        if art not in ("hit", "published", "exists"):
+            return
+        x_aval = ("__x__",
+                  (max_batch, bucket[0], bucket[1], PAIR_CHANNELS),
+                  "float32")
+        sig = params_aval_sig(params_sds, extra=(x_aval,))
+        key = resolution_key(name, cfg_digest, sig,
+                             store.backend or jax.default_backend(),
+                             jax.__version__)
+        ent = {"name": name, "fingerprint": row["fingerprint"],
+               "config_digest": cfg_digest, "aval_sig": sig,
+               "backend": store.backend or jax.default_backend(),
+               "jax": jax.__version__, "created": time.time()}
+        if extra_meta:
+            ent.update(extra_meta)
+        index_entries[key] = ent
 
     def _aot(name, lower_fn):
         compiled, row = ledger.record_aot(name, lower_fn, artifacts=store)
@@ -410,6 +445,11 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS),
                                          jnp.float32),
                     jax.ShapeDtypeStruct((1, h, w, 2), jnp.float32))
+            # the cold head grid is dtype-independent: derive it ONCE
+            # per bucket (one eval_shape) and share it across every
+            # tier's warm entry and the bucket's quality scorer — each
+            # formerly paid its own trace of the full cold network
+            bucket_hw: tuple[int, int] | None = None
             for tier in tiers:
                 # the tier's params AVALS through the same transform the
                 # engine applies to real weights — abstract, so no
@@ -421,36 +461,49 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                 for mode in modes:
                     before_files = _entries()
                     name = exec_name(bucket, tier, mode)
+                    idx_meta = None
                     if mode == "cold":
                         params_sds, x_sds = serve_avals(
                             cold_tier_sds, bucket, max_batch)
                         row, art = _aot(
                             name,
                             lambda: fwd.lower(params_sds, x_sds))
+                        index_params = cold_tier_sds
                     else:
                         refine_tier_sds = jax.eval_shape(
                             lambda p, _t=tier: quantize_params(p, _t),
                             refine_vars_sds["params"])
-                        prior_hw = cold_output_hw(fwd, cold_tier_sds,
-                                                  bucket, max_batch)
+                        if bucket_hw is None:
+                            bucket_hw = tuple(cold_output_hw(
+                                fwd, cold_tier_sds, bucket, max_batch))
+                        prior_hw = bucket_hw
                         params_sds, x_sds, prior_sds = refine_serve_avals(
                             refine_tier_sds, bucket, max_batch, prior_hw)
-                        # mirror the engine's prior-chain shape check:
-                        # a config the engine would reject must fail
-                        # warmup identically, not silently pre-compile
-                        out_sds = jax.eval_shape(refine_fwd, params_sds,
-                                                 x_sds, prior_sds)
-                        if tuple(out_sds.shape[1:3]) != tuple(prior_hw):
-                            raise ValueError(
-                                f"warm_start unsupported for model "
-                                f"{cfg.model!r} at bucket {bucket}: "
-                                f"refinement head grid "
-                                f"{tuple(out_sds.shape[1:3])} != cold "
-                                f"head grid {tuple(prior_hw)}")
-                        row, art = _aot(
-                            name,
-                            lambda: refine_fwd.lower(params_sds, x_sds,
-                                                     prior_sds))
+
+                        def lower_checked(_p=params_sds, _x=x_sds,
+                                          _pr=prior_sds, _hw=prior_hw):
+                            lowered = refine_fwd.lower(_p, _x, _pr)
+                            # mirror the engine's prior-chain shape
+                            # check off the lowering's OWN out_info —
+                            # one shared `lowered` per entry across the
+                            # grid check, fingerprint, ledger row, and
+                            # compile (no second trace); a config the
+                            # engine would reject must fail warmup
+                            # identically, not silently pre-compile
+                            out_hw = _lowered_out_hw(lowered)
+                            if out_hw != tuple(_hw):
+                                raise ValueError(
+                                    f"warm_start unsupported for model "
+                                    f"{cfg.model!r} at bucket {bucket}: "
+                                    f"refinement head grid {out_hw} != "
+                                    f"cold head grid {tuple(_hw)}")
+                            return lowered
+
+                        row, art = _aot(name, lower_checked)
+                        index_params = refine_tier_sds
+                        idx_meta = {"prior_hw": list(prior_hw)}
+                    _index(name, row, art, index_params, bucket,
+                           extra_meta=idx_meta)
                     hits = row["cache_hits"] or 0
                     # persisted = a new on-disk entry appeared
                     # (filesystem truth, not the counter's hope) OR the
@@ -477,11 +530,16 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
                     lambda p: quantize_params(p, tiers[0]),
                     variables_sds["params"])
                 before_files = _entries()
-                flow_hw = cold_output_hw(fwd, tier0_sds, bucket, max_batch)
+                if bucket_hw is None:
+                    bucket_hw = tuple(cold_output_hw(
+                        fwd, tier0_sds, bucket, max_batch))
+                flow_hw = bucket_hw
                 x_sds, flow_sds = quality_avals(bucket, flow_hw)
                 row, art = _aot(
                     quality_exec_name(bucket),
                     lambda: score_jit.lower(x_sds, flow_sds))
+                _index(quality_exec_name(bucket), row, art, tier0_sds,
+                       bucket, extra_meta={"flow_hw": list(flow_hw)})
                 hits = row["cache_hits"] or 0
                 wrote = bool(_entries() - before_files)
                 persisted = wrote or hits >= 1
@@ -508,4 +566,139 @@ def warmup_serve(cfg: ExperimentConfig) -> dict:
             "errors": sum(1 for a in arts
                           if isinstance(a, str) and a.startswith("error")),
         }
+        # the executable index: ONE atomic rename after the whole
+        # lattice published (readers see the old complete index until
+        # the new complete one lands — never a partial lattice)
+        try:
+            write_index(store.root, index_entries)
+            out["artifacts"]["index_entries"] = len(index_entries)
+            out["artifacts"]["config_digest"] = cfg_digest
+        except OSError as e:
+            print(f"warmup: index publish failed: {e}", file=sys.stderr)
+            out["artifacts"]["index_entries"] = 0
     return out
+
+
+def deep_verify_serve(cfg: ExperimentConfig) -> dict:
+    """Offline deep audit of the executable index (`deepof_tpu
+    artifacts verify --deep`): re-lower every lattice entry THIS config
+    would serve — the full bucket x tier x mode ladder plus quality
+    scorers, exactly the `warmup_serve` lowerings — and compare each
+    local StableHLO fingerprint against what the index maps that
+    entry's resolution key to. This is the same check the engine's
+    background deep-verify plane performs behind live serving, run
+    ahead of deployment instead: ``drift`` entries are executables an
+    index boot would serve stale (until demoted), ``unindexed`` ones
+    would miss to the compile path. Nothing is published or repaired —
+    re-run `warmup --serve` for that."""
+    import jax.numpy as jnp
+
+    from ..obs.ledger import (exec_name, fingerprint_text,
+                              quality_exec_name)
+    from ..obs.quality import make_score_fn, quality_avals
+    from ..serve.artifacts import (params_aval_sig, resolution_key,
+                                   serve_config_digest, store_for_config)
+    from ..serve.buckets import resolve_buckets
+    from ..serve.engine import (PAIR_CHANNELS, build_refine_model,
+                                build_serve_model, cold_output_hw,
+                                make_raw_forward, make_refine_forward,
+                                refine_serve_avals, serve_avals)
+    from ..serve.quant import quantize_params, resolve_precisions
+
+    store = store_for_config(cfg)
+    if store is None:
+        raise ValueError("artifacts verify --deep needs "
+                         "serve.artifacts_dir (or --dir) set")
+    cfg_digest = serve_config_digest(cfg)
+    model = build_serve_model(cfg)
+    buckets = resolve_buckets(cfg)
+    tiers = resolve_precisions(cfg)
+    modes = (("cold", "warm") if cfg.serve.session.warm_start
+             else ("cold",))
+    max_batch = max(cfg.serve.max_batch, 1)
+    fwd = jax.jit(make_raw_forward(model))
+    refine_model = refine_fwd = None
+    if "warm" in modes:
+        refine_model = build_refine_model(cfg)
+        refine_fwd = jax.jit(make_refine_forward(refine_model))
+    score_jit = (jax.jit(make_score_fn())
+                 if float(cfg.obs.quality_sample_rate) > 0 else None)
+    backend = store.backend or jax.default_backend()
+    key_sds = jax.ShapeDtypeStruct((2,), np.uint32)
+
+    entries: list[dict] = []
+
+    def _check(name, params_sds, bucket, lowered):
+        x_aval = ("__x__",
+                  (max_batch, bucket[0], bucket[1], PAIR_CHANNELS),
+                  "float32")
+        sig = params_aval_sig(params_sds, extra=(x_aval,))
+        key = resolution_key(name, cfg_digest, sig, backend,
+                             jax.__version__)
+        local_fp = fingerprint_text(lowered.as_text())
+        ent = store.index_entry(key) or {}
+        indexed_fp = ent.get("fingerprint")
+        status = ("unindexed" if indexed_fp is None
+                  else "ok" if indexed_fp == local_fp else "drift")
+        entries.append({"name": name, "key": key,
+                        "indexed": indexed_fp, "local": local_fp,
+                        "status": status})
+
+    for bucket in buckets:
+        h, w = bucket
+        variables_sds = jax.eval_shape(
+            model.init, key_sds,
+            jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS), jnp.float32))
+        refine_vars_sds = None
+        if refine_fwd is not None:
+            refine_vars_sds = jax.eval_shape(
+                refine_model.init, key_sds,
+                jax.ShapeDtypeStruct((1, h, w, PAIR_CHANNELS),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((1, h, w, 2), jnp.float32))
+        bucket_hw = None
+        for tier in tiers:
+            cold_tier_sds = jax.eval_shape(
+                lambda p, _t=tier: quantize_params(p, _t),
+                variables_sds["params"])
+            for mode in modes:
+                name = exec_name(bucket, tier, mode)
+                if mode == "cold":
+                    params_sds, x_sds = serve_avals(
+                        cold_tier_sds, bucket, max_batch)
+                    lowered = fwd.lower(params_sds, x_sds)
+                    _check(name, cold_tier_sds, bucket, lowered)
+                else:
+                    refine_tier_sds = jax.eval_shape(
+                        lambda p, _t=tier: quantize_params(p, _t),
+                        refine_vars_sds["params"])
+                    if bucket_hw is None:
+                        bucket_hw = tuple(cold_output_hw(
+                            fwd, cold_tier_sds, bucket, max_batch))
+                    params_sds, x_sds, prior_sds = refine_serve_avals(
+                        refine_tier_sds, bucket, max_batch, bucket_hw)
+                    lowered = refine_fwd.lower(params_sds, x_sds,
+                                               prior_sds)
+                    _check(name, refine_tier_sds, bucket, lowered)
+        if score_jit is not None:
+            tier0_sds = jax.eval_shape(
+                lambda p: quantize_params(p, tiers[0]),
+                variables_sds["params"])
+            if bucket_hw is None:
+                bucket_hw = tuple(cold_output_hw(
+                    fwd, tier0_sds, bucket, max_batch))
+            x_sds, flow_sds = quality_avals(bucket, bucket_hw)
+            lowered = score_jit.lower(x_sds, flow_sds)
+            _check(quality_exec_name(bucket), tier0_sds, bucket, lowered)
+
+    return {
+        "dir": store.root,
+        "backend": backend,
+        "config_digest": cfg_digest,
+        "entries": entries,
+        "total": len(entries),
+        "ok": sum(1 for e in entries if e["status"] == "ok"),
+        "drift": [e["name"] for e in entries if e["status"] == "drift"],
+        "unindexed": [e["name"] for e in entries
+                      if e["status"] == "unindexed"],
+    }

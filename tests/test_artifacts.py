@@ -17,6 +17,15 @@ Chaos tier (slow, subprocess): a REAL-model fleet with the store on —
 SIGKILL the scale-up replica mid-boot, the supervisor respawns it, every
 request resolves via failover, and the respawned replica's ledger shows
 it booted from artifacts (zero "aot" rows fleet-wide).
+
+r17 executable index tier: the trace-free resolution plane — pure key
+algebra (resolution_key / serve_config_digest / params_aval_sig),
+atomic index publish + tolerant load, the resolve() trust gates (forged
+entry, stale target, cross-wired name, version skew, tampered payload —
+every one a loud counted reject), roots-pinned GC with index pruning,
+supervisor GC wiring, the index-boot engine (only index_hit rows — zero
+trace/lower on the resolve path), config-drift miss + fallback, the
+deep-verify demote drill, and `artifacts verify --deep`'s rc contract.
 """
 
 import dataclasses
@@ -31,9 +40,12 @@ import zlib
 import numpy as np
 import pytest
 
-from deepof_tpu.serve.artifacts import (BLOB, MANIFEST, gc_store,
-                                        store_entries, verify_entry,
-                                        verify_store)
+from deepof_tpu.serve.artifacts import (BLOB, INDEX, MANIFEST, gc_store,
+                                        index_targets, load_index,
+                                        resolution_key,
+                                        serve_config_digest, store_entries,
+                                        verify_entry, verify_store,
+                                        write_index)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -132,12 +144,12 @@ def test_gc_older_than_keeps_fresh_valid_entries(tmp_path):
 # ------------------------------------------------------- cli verb
 
 
-def _cli(args):
+def _cli(args, timeout=60):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run([sys.executable, "-m", "deepof_tpu", "artifacts",
                            *args], capture_output=True, text=True, env=env,
-                          timeout=60)
+                          timeout=timeout)
 
 
 def test_cli_artifacts_rc_contract(tmp_path):
@@ -340,11 +352,13 @@ def test_ledger_diff_artifact_load_is_not_a_recompile(tmp_path):
 @pytest.mark.slow
 def test_warmup_publishes_ladder_then_cold_engine_boots_from_store(
         tmp_path):
-    """The tentpole acceptance, in-process: `warmup --serve` publishes
-    the full bucket x tier ladder into the store (single writer), a
-    cold engine (cleared jax caches) warms with ONLY artifact hits —
-    zero compiles — and serves flows BITWISE equal to a compile-path
-    engine's on identical requests at the same bucket/tier."""
+    """The r16 acceptance, in-process: `warmup --serve` publishes the
+    full bucket x tier ladder into the store (single writer), a cold
+    engine (cleared jax caches, index OFF — the fingerprint boot path
+    kept for continuity; the r17 index path has its own test below)
+    warms with ONLY artifact hits — zero compiles — and serves flows
+    BITWISE equal to a compile-path engine's on identical requests at
+    the same bucket/tier."""
     import jax
     import jax.numpy as jnp
 
@@ -382,8 +396,10 @@ def test_warmup_publishes_ladder_then_cold_engine_boots_from_store(
              rng.randint(1, 255, (30, 60, 3), dtype=np.uint8), t)
             for t in tiers]
 
-    jax.clear_caches()  # the cold scaled-up replica
-    with InferenceEngine(cfg, model_params=(model, params)) as eng:
+    jax.clear_caches()  # the cold scaled-up replica (fingerprint path)
+    cfg_fp = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                   artifacts_index=False))
+    with InferenceEngine(cfg_fp, model_params=(model, params)) as eng:
         eng.warm()
         st = eng.stats()
         assert st["exec_artifact_hits"] >= ladder, st
@@ -408,6 +424,480 @@ def test_warmup_publishes_ladder_then_cold_engine_boots_from_store(
     for fa, fc in zip(flows_art, flows_cmp):
         assert fa.dtype == fc.dtype
         assert (fa == fc).all(), "artifact executable diverged bitwise"
+
+
+# --------------------------------------------- r17: executable index
+
+
+def _index_entry(name, fp, backend="cpu", jax_version=None,
+                 config_digest="d" * 16, aval_sig="s" * 16, **overrides):
+    """A well-formed index entry plus its honest resolution key."""
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+    ent = {"name": name, "fingerprint": fp,
+           "config_digest": config_digest, "aval_sig": aval_sig,
+           "backend": backend, "jax": jax_version, "created": 123.0}
+    ent.update(overrides)
+    key = resolution_key(ent["name"], ent["config_digest"],
+                         ent["aval_sig"], ent["backend"], ent["jax"])
+    return key, ent
+
+
+def test_resolution_key_and_config_digest_are_pure():
+    """jax-free key algebra: deterministic, sensitive to every
+    component; the config digest covers exactly the lowering-relevant
+    subset — replica plumbing (ports, log dirs, store paths) must NOT
+    flip it, while anything that shapes the lattice must."""
+    k = resolution_key("n", "d" * 16, "s" * 16, "cpu", "1.0")
+    assert k == resolution_key("n", "d" * 16, "s" * 16, "cpu", "1.0")
+    assert len(k) == 16 and all(c in "0123456789abcdef" for c in k)
+    others = [resolution_key("m", "d" * 16, "s" * 16, "cpu", "1.0"),
+              resolution_key("n", "e" * 16, "s" * 16, "cpu", "1.0"),
+              resolution_key("n", "d" * 16, "t" * 16, "cpu", "1.0"),
+              resolution_key("n", "d" * 16, "s" * 16, "tpu", "1.0"),
+              resolution_key("n", "d" * 16, "s" * 16, "cpu", "2.0")]
+    assert len({k, *others}) == 6
+
+    from deepof_tpu.core.config import get_config
+
+    cfg = get_config("flyingchairs")
+    base = serve_config_digest(cfg)
+    runtime = cfg.replace(
+        train=dataclasses.replace(cfg.train, log_dir="/elsewhere"),
+        serve=dataclasses.replace(
+            cfg.serve, port=9999, artifacts_dir="/some/store",
+            fleet=dataclasses.replace(cfg.serve.fleet, replicas=7)))
+    assert serve_config_digest(runtime) == base
+    assert serve_config_digest(cfg.replace(width_mult=0.5)) != base
+    assert serve_config_digest(cfg.replace(serve=dataclasses.replace(
+        cfg.serve, max_batch=cfg.serve.max_batch + 1))) != base
+
+
+def test_index_write_is_atomic_merge_and_load_is_tolerant(tmp_path):
+    """write_index merges over the existing index through a tmp-file +
+    rename (no torn reader window, no staging left behind); load_index
+    treats an absent/torn/wrong-schema index as EMPTY — on the boot
+    path that is a miss, never an exception."""
+    root = str(tmp_path / "exec")
+    k1, e1 = _index_entry("a", "1" * 16)
+    write_index(root, {k1: e1})
+    k2, e2 = _index_entry("b", "2" * 16)
+    idx = write_index(root, {k2: e2})
+    assert set(idx["entries"]) == {k1, k2}  # merge, not replace
+    assert load_index(root)["entries"][k1]["fingerprint"] == "1" * 16
+    assert index_targets(root) == {"1" * 16, "2" * 16}
+    assert not [n for n in os.listdir(root) if n.startswith(".tmp-")]
+
+    with open(os.path.join(root, INDEX), "w") as f:
+        f.write('{"schema": 1, "entries": {"x": ')  # torn mid-write
+    assert load_index(root)["entries"] == {}
+    with open(os.path.join(root, INDEX), "w") as f:
+        json.dump({"schema": 99, "entries": {}}, f)
+    assert load_index(root)["entries"] == {}
+    assert index_targets(os.path.join(root, "missing")) == set()
+
+
+def test_index_resolve_roundtrip_counts_and_row(tmp_path):
+    """record_index: an honest entry resolves trace-free (fetch +
+    deserialize only), writes the cache_verdict="index_hit" row
+    carrying the INDEX's fingerprint, queues one deep-verify slot, and
+    the resolved executable's outputs are bitwise equal to the
+    compile-path one's. A drifted config is a DIFFERENT key: a clean
+    counted miss, no row."""
+    store = _store(tmp_path)
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", _tiny_lower(), artifacts=store)
+    store.publish(row["fingerprint"], compiled, name="demo")
+    key, ent = _index_entry("demo", row["fingerprint"])
+    write_index(store.root, {key: ent})
+
+    led2 = _ledger(tmp_path, "b")
+    c2, row2, verdict = led2.record_index("demo", _store(tmp_path), key)
+    assert verdict == "index_hit"
+    assert row2["compile_kind"] == "artifact"
+    assert row2["cache_verdict"] == "index_hit"
+    assert row2["fingerprint"] == row["fingerprint"]
+    assert row2["resolve_s"] is not None
+    st = led2.stats()
+    assert st["exec_index_hits"] == 1 and st["exec_index_misses"] == 0
+    assert st["exec_index_rejects"] == 0
+    assert st["exec_deep_verify_pending"] == 1
+    led2.note_deep_verify(True)
+    st = led2.stats()
+    assert st["exec_deep_verify_pending"] == 0
+    assert st["exec_deep_verify_ok"] == 1
+
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 8).astype(np.float32)
+    for a, b in zip(compiled(x, y), c2(x, y)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+    k_drift, _ = _index_entry("demo", row["fingerprint"],
+                              config_digest="f" * 16)
+    c3, row3, verdict3 = led2.record_index("demo", _store(tmp_path),
+                                           k_drift)
+    assert (c3, row3, verdict3) == (None, None, "index_miss")
+    assert led2.stats()["exec_index_misses"] == 1
+
+
+def test_index_trust_gates_reject_loudly(tmp_path, capsys):
+    """Every poisoned-index case REFUSES to serve, warns on stderr, and
+    counts in exec_index_rejects: a forged entry (components do not
+    hash back to the key), a stale target (entry outlived its
+    executable), a cross-wired target (manifest name disagrees), a
+    version-skewed entry, and a tampered payload behind an honest
+    entry. None of them raises — the caller falls back to the lowering
+    path."""
+    store = _store(tmp_path)
+    led = _ledger(tmp_path, "a")
+    compiled, row = led.record_aot("demo", _tiny_lower(), artifacts=store)
+    fp = row["fingerprint"]
+    store.publish(fp, compiled, name="demo")
+    led2 = _ledger(tmp_path, "b")
+
+    def resolve(key):
+        return led2.record_index("demo", _store(tmp_path), key)[2]
+
+    # forged: key hashed over name "demo", entry claims another name
+    key, ent = _index_entry("demo", fp)
+    write_index(store.root, {key: dict(ent, name="other")})
+    assert resolve(key) == "index_reject:entry_forged"
+
+    # stale target: honest entry, executable no longer in the store
+    k2, e2 = _index_entry("demo", "0" * 16)
+    write_index(store.root, {k2: e2})
+    assert resolve(k2) == "index_reject:stale_target"
+
+    # cross-wired: honest entry under another name pointing at demo's
+    # artifact — the target manifest's recorded name disagrees
+    k3, e3 = _index_entry("other", fp)
+    write_index(store.root, {k3: e3})
+    assert resolve(k3) == "index_reject:name_mismatch"
+
+    # version skew: entry lowered under another jax
+    k4, e4 = _index_entry("demo", fp, jax_version="0.0.0")
+    write_index(store.root, {k4: e4})
+    assert resolve(k4) == "index_reject:jax_version_mismatch"
+
+    # tampered payload behind an honest entry: the fetch gates fire
+    write_index(store.root, {key: ent})
+    blob = os.path.join(store.root, fp, BLOB)
+    data = open(blob, "rb").read()
+    with open(blob, "wb") as f:
+        f.write(data[:-4] + b"XXXX")
+    assert resolve(key).startswith("index_reject:target_")
+
+    st = led2.stats()
+    assert st["exec_index_rejects"] == 5
+    assert st["exec_index_hits"] == 0
+    assert "INDEX REJECT" in capsys.readouterr().err
+
+
+def test_gc_pins_roots_and_index_targets_and_prunes_stale(tmp_path):
+    """Retirement-path GC safety: live-lattice roots and the index's
+    own targets are pinned against the age sweep; a corrupt entry goes
+    regardless and its index entries are PRUNED (a later boot takes a
+    clean miss, not a stale-target reject); leftover `.tmp-*-index.json`
+    staging FILES are swept like tmp dirs."""
+    root = str(tmp_path / "exec")
+    old = time.time() - 40 * 86400
+    _fake_entry(root, "a" * 16, created=old)  # pinned via roots
+    _fake_entry(root, "b" * 16, created=old)  # pinned via the index
+    _fake_entry(root, "c" * 16, created=old)  # unpinned: swept by age
+    _fake_entry(root, "e" * 16, created=old)  # corrupt: goes regardless
+    with open(os.path.join(root, "e" * 16, BLOB), "wb") as f:
+        f.write(b"tampered" * 8)
+    kb, eb = _index_entry("fake", "b" * 16)
+    ke, ee = _index_entry("fake2", "e" * 16, aval_sig="t" * 16)
+    write_index(root, {kb: eb, ke: ee})
+    with open(os.path.join(root, ".tmp-42-index.json"), "w") as f:
+        f.write("{}")
+
+    gc = gc_store(root, older_than_days=30, roots={"a" * 16})
+    assert sorted(gc["removed"]) == ["c" * 16, "e" * 16]
+    assert sorted(gc["kept"]) == ["a" * 16, "b" * 16]
+    assert ".tmp-42-index.json" in gc["tmp_removed"]
+    assert gc["index_pruned"] == [ke]
+    assert set(load_index(root)["entries"]) == {kb}
+    assert not os.path.exists(os.path.join(root, ".tmp-42-index.json"))
+
+
+def test_fleet_retirement_gc_wiring(tmp_path):
+    """Satellite 1: the supervisor's retirement hook sweeps the store
+    with every replica ledger's fingerprints as roots (index targets
+    pinned inside gc_store) and logs one warn record into the fleet's
+    metrics.jsonl — exercised directly, no processes spawned."""
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.fleet import Fleet
+
+    store_root = str(tmp_path / "exec")
+    old = time.time() - 40 * 86400
+    _fake_entry(store_root, "a" * 16, created=old)  # a live ledger's fp
+    _fake_entry(store_root, "b" * 16, created=old)  # unpinned: swept
+    fleet_dir = str(tmp_path / "fleet")
+    rdir = os.path.join(fleet_dir, "replica-0")
+    os.makedirs(rdir)
+    with open(os.path.join(rdir, "ledger.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "lowering", "name": "x",
+                            "fingerprint": "a" * 16}) + "\n")
+
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        serve=dataclasses.replace(
+            cfg.serve, artifacts_dir=store_root,
+            fleet=dataclasses.replace(cfg.serve.fleet,
+                                      artifacts_gc_days=30.0)),
+        train=dataclasses.replace(cfg.train, log_dir=fleet_dir))
+    fleet = Fleet(cfg, 1)
+    fleet._artifacts_gc("test")
+    assert store_entries(store_root) == ["a" * 16]
+    recs = [json.loads(line)
+            for line in open(os.path.join(fleet_dir, "metrics.jsonl"))]
+    assert any("artifacts gc" in r.get("message", "") for r in recs)
+
+
+@pytest.mark.slow
+def test_index_boot_is_trace_free_and_bitwise_equal(tmp_path):
+    """The r17 tentpole acceptance, in-process: `warmup --serve` writes
+    the executable index, a cold engine resolves the WHOLE ladder
+    through it — ledger provenance shows ONLY index_hit rows on the
+    resolve path (zero "aot", zero untagged lowerings; deep-verify rows
+    are the off-path integrity plane, which confirms every entry) —
+    and serves flows bitwise equal to the compile-path engine's. A
+    config drift (different lowering-relevant subset) flips the
+    resolution key: the index MISSES and the engine falls back to the
+    compile path, loudly counted."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.engine import InferenceEngine, build_serve_model
+    from deepof_tpu.train import warmup
+
+    tiers = ("f32", "bf16")
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(cfg.serve, max_batch=2,
+                                  batch_timeout_ms=40.0,
+                                  buckets=((32, 64),), precisions=tiers,
+                                  artifacts_dir=str(tmp_path / "exec")),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(tmp_path / "publish")))
+    rep = warmup.warmup_serve(cfg)
+    ladder = len(rep["buckets"])
+    assert rep["artifacts"]["index_entries"] == ladder
+
+    model = build_serve_model(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 64, 6)))["params"]
+    rng = np.random.RandomState(0)
+    reqs = [(rng.randint(1, 255, (30, 60, 3), dtype=np.uint8),
+             rng.randint(1, 255, (30, 60, 3), dtype=np.uint8), t)
+            for t in tiers]
+
+    jax.clear_caches()  # the cold scaled-up replica (index path)
+    cfg_cold = cfg.replace(train=dataclasses.replace(
+        cfg.train, log_dir=str(tmp_path / "cold")))
+    with InferenceEngine(cfg_cold, model_params=(model, params)) as eng:
+        eng.warm()
+        st = eng.stats()
+        assert st["exec_index_hits"] >= ladder, st
+        assert st["exec_index_misses"] == 0, st
+        assert st["exec_index_rejects"] == 0, st
+        # resolution never even reached the fingerprint path
+        assert st["exec_artifact_hits"] == 0, st
+        flows_idx = [eng.submit(p, n, precision=t).result(timeout=300)
+                     ["flow"] for p, n, t in reqs]
+        assert eng.deep_verify_join(timeout_s=300)
+        st = eng.stats()
+        assert st["exec_deep_verify_ok"] >= ladder, st
+        assert st["exec_deep_verify_demoted"] == 0, st
+        assert st["exec_deep_verify_pending"] == 0, st
+    rows = [json.loads(line)
+            for line in open(tmp_path / "cold" / "ledger.jsonl")]
+    kinds = [r.get("compile_kind") for r in rows]
+    assert kinds.count("artifact") >= ladder
+    for r in rows:
+        assert r.get("compile_kind") in (None, "artifact",
+                                         "deep_verify"), r
+        if r.get("compile_kind") == "artifact":
+            assert r.get("cache_verdict") == "index_hit", r
+
+    jax.clear_caches()  # the compile-path control engine
+    cfg_off = cfg.replace(
+        serve=dataclasses.replace(cfg.serve, artifacts_dir=""),
+        train=dataclasses.replace(cfg.train,
+                                  log_dir=str(tmp_path / "control")))
+    with InferenceEngine(cfg_off, model_params=(model, params)) as eng:
+        eng.warm()
+        flows_cmp = [eng.submit(p, n, precision=t).result(timeout=300)
+                     ["flow"] for p, n, t in reqs]
+    for fa, fc in zip(flows_idx, flows_cmp):
+        assert fa.dtype == fc.dtype
+        assert (fa == fc).all(), "index executable diverged bitwise"
+
+    # config drift: a bigger max_batch lowers different avals — the
+    # key changes, the index misses, the compile path takes over
+    jax.clear_caches()
+    cfg_drift = cfg.replace(
+        serve=dataclasses.replace(cfg.serve, max_batch=3),
+        train=dataclasses.replace(cfg.train,
+                                  log_dir=str(tmp_path / "drift")))
+    with InferenceEngine(cfg_drift, model_params=(model, params)) as eng:
+        eng.warm()
+        st = eng.stats()
+        assert st["exec_index_misses"] >= ladder, st
+        assert st["exec_index_hits"] == 0, st
+    kinds = [json.loads(line).get("compile_kind")
+             for line in open(tmp_path / "drift" / "ledger.jsonl")]
+    assert kinds.count("aot") >= ladder  # loud fallback, not silence
+
+
+@pytest.mark.slow
+def test_deep_verify_demotes_cross_wired_index_entry(tmp_path):
+    """The deferred integrity plane: cross-wire the f32 cold entry to
+    the bf16 tier's artifact with the target manifest's name forged to
+    match — every boot-path gate passes, so the engine serves the
+    stale index hit. The background deep verify re-lowers, sees the
+    fingerprint mismatch, DEMOTES loudly (counter + ledger row) and
+    swaps in a fresh compile; requests after the swap produce flows
+    bitwise equal to the compile path's."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.serve.engine import InferenceEngine, build_serve_model
+    from deepof_tpu.train import warmup
+
+    store_root = str(tmp_path / "exec")
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(cfg.serve, max_batch=2,
+                                  batch_timeout_ms=40.0,
+                                  buckets=((32, 64),),
+                                  precisions=("f32", "bf16"),
+                                  artifacts_dir=store_root),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6),
+                                  log_dir=str(tmp_path / "publish")))
+    warmup.warmup_serve(cfg)
+
+    # the poisoning: f32's entry now claims bf16's artifact, and the
+    # target manifest is forged to agree on the name
+    idx = load_index(store_root)
+    by_name = {e["name"]: (k, e) for k, e in idx["entries"].items()}
+    (k_f32, e_f32), = [v for n, v in by_name.items()
+                       if n.endswith(":f32:cold")]
+    (_, e_bf16), = [v for n, v in by_name.items()
+                    if n.endswith(":bf16:cold")]
+    victim_fp = e_bf16["fingerprint"]
+    assert victim_fp != e_f32["fingerprint"]
+    write_index(store_root, {k_f32: dict(e_f32, fingerprint=victim_fp)})
+    man_path = os.path.join(store_root, victim_fp, MANIFEST)
+    man = json.load(open(man_path))
+    man["name"] = e_f32["name"]
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+
+    model = build_serve_model(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 32, 64, 6)))["params"]
+    jax.clear_caches()
+    cfg_cold = cfg.replace(train=dataclasses.replace(
+        cfg.train, log_dir=str(tmp_path / "cold")))
+    with InferenceEngine(cfg_cold, model_params=(model, params)) as eng:
+        eng.warm()
+        st = eng.stats()
+        assert st["exec_index_hits"] >= 1, st  # the poisoned hit served
+        assert eng.deep_verify_join(timeout_s=300)
+        st = eng.stats()
+        assert st["exec_deep_verify_demoted"] == 1, st
+        assert st["exec_deep_verify_pending"] == 0, st
+        # after the swap: a real f32 request through the replacement
+        rng = np.random.RandomState(0)
+        prev = rng.randint(1, 255, (30, 60, 3), dtype=np.uint8)
+        nxt = rng.randint(1, 255, (30, 60, 3), dtype=np.uint8)
+        flow = eng.submit(prev, nxt, precision="f32").result(
+            timeout=300)["flow"]
+    rows = [json.loads(line)
+            for line in open(tmp_path / "cold" / "ledger.jsonl")]
+    assert any(r.get("cache_verdict") == "deep_verify_demoted"
+               for r in rows), [r.get("cache_verdict") for r in rows]
+
+    jax.clear_caches()  # compile-path control for bitwise equality
+    cfg_off = cfg.replace(
+        serve=dataclasses.replace(cfg.serve, artifacts_dir=""),
+        train=dataclasses.replace(cfg.train,
+                                  log_dir=str(tmp_path / "control")))
+    with InferenceEngine(cfg_off, model_params=(model, params)) as eng:
+        flow_cmp = eng.submit(prev, nxt, precision="f32").result(
+            timeout=300)["flow"]
+    assert flow.dtype == flow_cmp.dtype
+    assert (flow == flow_cmp).all(), "demote swap-in diverged bitwise"
+
+
+@pytest.mark.slow
+def test_cli_artifacts_verify_deep_rc_contract(tmp_path):
+    """`deepof_tpu artifacts verify --deep` re-lowers the lattice under
+    the given config and compares StableHLO fingerprints against the
+    index across a PROCESS boundary (fingerprints must be stable or the
+    whole plane is fiction): rc 0 when every indexed entry matches,
+    rc 1 on drift (tampered index fingerprint), rc 2 when nothing is
+    indexed."""
+    import dataclasses as dc
+
+    from deepof_tpu.core.config import get_config
+    from deepof_tpu.train import warmup
+
+    store_root = str(tmp_path / "exec")
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dc.replace(cfg.data, image_size=(32, 64), gt_size=(32, 64),
+                        dataset="synthetic"),
+        serve=dc.replace(cfg.serve, max_batch=2, buckets=((32, 64),),
+                         precisions=("f32",), artifacts_dir=store_root),
+        train=dc.replace(cfg.train, eval_amplifier=1.0,
+                         eval_clip=(-1e6, 1e6),
+                         log_dir=str(tmp_path / "publish")))
+    warmup.warmup_serve(cfg)
+
+    deep_args = ["verify", "--deep", "--dir", store_root,
+                 "--model", "flownet_s",
+                 "--set", "width_mult=0.25",
+                 "--set", "data.image_size=(32,64)",
+                 "--set", "data.gt_size=(32,64)",
+                 "--set", "serve.max_batch=2",
+                 "--set", "serve.buckets=((32,64),)",
+                 "--set", "serve.precisions=('f32',)"]
+    r = _cli(deep_args, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["ok"] == rep["total"] >= 1
+    assert rep["drift"] == [] and rep["unindexed"] == []
+
+    # drift: tamper the indexed fingerprint — rc 1, the entry named
+    idx = load_index(store_root)
+    key, ent = next(iter(idx["entries"].items()))
+    write_index(store_root, {key: dict(ent, fingerprint="9" * 16)})
+    r = _cli(deep_args, timeout=300)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["drift"] == [ent["name"]]
+
+    # empty: no index at all — rc 2
+    os.remove(os.path.join(store_root, INDEX))
+    r = _cli(deep_args, timeout=300)
+    assert r.returncode == 2, (r.stdout, r.stderr)
 
 
 # ----------------------------------------------- slow chaos: the drill
@@ -575,15 +1065,21 @@ def test_fleet_chaos_scale_up_sigkill_respawns_from_artifacts(tmp_path):
     bad = [(s, p[:120]) for s, p in outcomes if s != 200]
     assert not bad, (len(outcomes), bad[:5])
 
-    # the respawned replica booted from artifacts: its ledger has
-    # artifact rows and the whole fleet compiled NOTHING
+    # the respawned replica booted from the INDEX — trace-free: its
+    # ledger has index_hit rows, and fleet-wide the only compile kinds
+    # anywhere are "artifact" (index/fingerprint resolution) and
+    # "deep_verify" (the background integrity plane, off the boot
+    # path) — zero "aot" rows, zero untagged lowerings
     new_ledger = fleet_dir / f"replica-{new_idx}" / "ledger.jsonl"
-    kinds = [json.loads(line).get("compile_kind")
-             for line in open(new_ledger)]
+    rows = [json.loads(line) for line in open(new_ledger)]
+    kinds = [r.get("compile_kind") for r in rows]
     assert kinds.count("artifact") >= 1, kinds
+    assert any(r.get("cache_verdict") == "index_hit" for r in rows), \
+        [(r.get("compile_kind"), r.get("cache_verdict")) for r in rows]
     for rdir in sorted(fleet_dir.glob("replica-*")):
         lp = rdir / "ledger.jsonl"
         if lp.exists():
             for line in open(lp):
-                assert json.loads(line).get("compile_kind") != "aot", \
-                    f"{rdir.name} compiled instead of fetching"
+                k = json.loads(line).get("compile_kind")
+                assert k in (None, "artifact", "deep_verify"), \
+                    f"{rdir.name} compiled ({k}) instead of fetching"

@@ -82,6 +82,16 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
      ("serve_bench_artifact", "cold_start_speedup"), "higher"),
     ("bench_artifact_acquire_speedup",
      ("serve_bench_artifact", "acquire_speedup"), "higher"),
+    # r17 trace-free replica boot: the index leg's wall (fetch +
+    # deserialize only — zero trace/lower), the r16 fingerprint boot
+    # kept for continuity, and what moving integrity off the boot path
+    # bought (fingerprint wall / index wall)
+    ("bench_artifact_index_wall_s",
+     ("serve_bench_artifact", "warm_wall_index_s"), "lower"),
+    ("bench_artifact_fingerprint_boot_speedup",
+     ("serve_bench_artifact", "fingerprint_boot_speedup"), "higher"),
+    ("bench_artifact_index_vs_fingerprint_speedup",
+     ("serve_bench_artifact", "index_vs_artifact_speedup"), "higher"),
     # r15 executable ledger (obs/ledger.py + serve_bench
     # --ledger-overhead): hot-path cost of ledgering (bounded <= 2%),
     # total lattice compile seconds, and the measured-vs-nominal-
