@@ -630,6 +630,14 @@ def tail_summary(log_dir: str, recent: int = 10,
                          run_rows=rows, **(ledger_bounds or {}))
     if drift is not None:
         out["ledger_diff"] = drift
+    # incident-plane surface (obs/incident.py): the committed bundle
+    # summary the CLI maps to exit code 9 (unacked critical) — absent
+    # entirely when the run recorded no incidents
+    from .obs.incident import incident_summary
+
+    inc = incident_summary(log_dir)
+    if inc is not None:
+        out["incidents"] = inc
     return out
 
 
@@ -684,6 +692,11 @@ def analyze(log_dir: str, plot: bool = True) -> dict:
     drift = ledger_drift(log_dir, fleet=True, run_rows=rows)
     if drift is not None:
         summary["ledger_diff"] = drift
+    from .obs.incident import incident_summary
+
+    inc = incident_summary(log_dir)
+    if inc is not None:
+        summary["incidents"] = inc
     if plot:
         summary["plots"] = plot_curves(records, log_dir)
     return summary

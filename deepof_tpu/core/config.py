@@ -330,6 +330,37 @@ class ObsConfig:
     quality_window: int = 256
     quality_drift_factor: float = 2.0
     quality_budget: float = 0.1
+    # --- Incident plane (obs/incident.py, DESIGN.md "Incident plane") ---
+    # Anomaly-triggered flight recorder: every verdict site (watchdog
+    # wedge, fleet eviction/broken, elastic re-form/abort, SLO/quality
+    # budget exhaustion, ledger drift, deep-verify demote, NaN
+    # rollback) snapshots a bounded evidence bundle into
+    # <log_dir>/incidents/ — trace ring, last-K heartbeats, metrics
+    # tail, thread stacks, ledger rows, manifest. False (the default)
+    # is a structural no-op: no recorder object exists, no incident_*
+    # key enters any stats block, zero hot-path cost.
+    incidents: bool = False
+    # Token-bucket rate limit across ALL incident kinds: burst capacity
+    # refilled at rate_per_min — a trigger storm cannot fill the disk.
+    incident_rate_per_min: float = 6.0
+    incident_burst: int = 3
+    # Per-kind dedup: a kind that already captured within this window
+    # is counted (incident_deduped), not re-captured. Also the re-fire
+    # cadence of a continuously-true alert rule.
+    incident_dedup_window_s: float = 300.0
+    # Bundle bounds: newest metrics/ledger lines per bundle, heartbeat
+    # samples ring-buffered into heartbeats.jsonl, and the committed-
+    # bundle count beyond which the oldest are pruned at capture time.
+    incident_metrics_tail: int = 200
+    incident_heartbeats: int = 8
+    incident_keep: int = 32
+    # Declarative alert rules evaluated on the heartbeat cadence over
+    # registry-declared counters — "[name:] [rate(]counter[)] OP value
+    # [warn|critical]", e.g. "err_burst: rate(serve_errors) > 5
+    # critical". A firing rule records an incident of kind
+    # alert_<name>. Malformed rules and unregistered counters fail
+    # loudly at process start.
+    alerts: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -576,6 +607,12 @@ class ServeConfig:
     # crc gates alone (offline audits remain available via
     # `deepof_tpu artifacts verify --deep`).
     artifacts_deep_verify: bool = True
+    # Deep-verify pacing: the background verifier re-lowers ONE queued
+    # entry per tick of this interval instead of burning through the
+    # whole lattice in a tight loop — a hundred-entry lattice must not
+    # monopolize a core right after boot. 0 = no stagger (drain as
+    # fast as the re-lowers run).
+    deep_verify_interval_s: float = 0.05
     # Streaming video sessions (serve/session.py): POST /v1/flow/stream
     # keeps the last frame per session so consecutive pairs cost one
     # decode, not two; the router pins each session to one replica.

@@ -56,7 +56,8 @@ from dataclasses import dataclass
 #: dict key with one of these prefixes written anywhere in the package
 #: must resolve in this registry.
 LINTED_PREFIXES: tuple[str, ...] = (
-    "serve_", "fleet_", "elastic_", "data_", "fault_", "exec_")
+    "serve_", "fleet_", "elastic_", "data_", "fault_", "exec_",
+    "incident_", "alert_")
 
 MERGE_KINDS: frozenset[str] = frozenset((
     "sum", "max", "gauge", "bool", "hist", "map", "state", "derived"))
@@ -269,6 +270,20 @@ _ENTRIES: list[Key] = [
            resilience=True),
     # non-resilience ckpt counter (rides the same ckpt_ stats prefix)
     Key("ckpt_saves", "sum", "ckpt"),
+    # --------------- incident_*/alert_* (obs/incident.py, the flight
+    # recorder): capture/dedup/rate-limit accounting plus the alert-
+    # rule engine. Deliberately NOT resilience-surfaced — the legacy
+    # resilience tuple's key order is byte-pinned output; bundles
+    # surface through the dedicated `incidents` analyze/tail block.
+    *_keys("incident", "sum",
+           "incident_captured", "incident_collected",
+           "incident_deduped", "incident_rate_limited",
+           "incident_capture_errors"),
+    Key("incident_by_kind", "map", "incident"),
+    Key("incident_last_kind", "state", "incident"),
+    Key("alert_rules", "gauge", "incident"),
+    Key("alert_firings", "sum", "incident"),
+    Key("alert_errors", "sum", "incident"),
 ]
 
 #: name -> Key for exact entries (validated no-duplicate below).

@@ -479,6 +479,15 @@ class InferenceEngine:
         # daemon thread; close() stops it.
         self._deep_verify_q: queue.Queue = queue.Queue()
         self._deep_verify_thread: threading.Thread | None = None
+        # pacing: one re-lower per serve.deep_verify_interval_s tick —
+        # a hundred-entry lattice must not monopolize a core after
+        # boot. The event doubles as the close() wake-up so a long
+        # interval never stalls shutdown.
+        self._deep_verify_stop = threading.Event()
+        # incident plane (obs/incident.py): the process-level recorder,
+        # installed by server.py when obs.incidents is on. None keeps
+        # every trigger site a structural no-op (one attribute check).
+        self.incidents = None
         # cold-head output grid per bucket (one eval_shape each, shared
         # by every tier's warm entry and the bucket's quality scorer —
         # the grid is dtype-independent, so re-deriving it per tier was
@@ -1188,6 +1197,7 @@ class InferenceEngine:
             t.start()
 
     def _deep_verify_loop(self) -> None:
+        interval = max(float(self.cfg.serve.deep_verify_interval_s), 0.0)
         while True:
             item = self._deep_verify_q.get()
             if item is None:
@@ -1202,6 +1212,11 @@ class InferenceEngine:
                     self._ledger.note_deep_verify(True)
             finally:
                 self._deep_verify_q.task_done()
+            if interval > 0:
+                # stagger AFTER task_done: deep_verify_join() sees the
+                # entry complete immediately, and close() skips the
+                # wait via the stop event
+                self._deep_verify_stop.wait(interval)
 
     def _deep_verify_one(self, name, serve_key, quality_bucket,
                          expected_fp) -> None:
@@ -1251,6 +1266,13 @@ class InferenceEngine:
         print(f"serve: DEEP-VERIFY DEMOTE {name}: index claimed "
               f"{expected_fp}, local code lowers to {fp} — swapping in "
               f"a fresh compile", file=sys.stderr)
+        if self.incidents is not None:
+            # the drifted executable SERVED requests before this verdict
+            # — the evidence bundle (ledger tail, trace) is the story
+            self.incidents.record(
+                "deep_verify_demote", "critical",
+                trigger={"exec": name, "expected_fp": expected_fp,
+                         "actual_fp": fp})
         compiled = lowered.compile()
         with self._compile_lock:
             if serve_key is not None:
@@ -1423,6 +1445,21 @@ class InferenceEngine:
                 hist, requests, failures,
                 self.cfg.obs.slo_latency_ms,
                 self.cfg.obs.slo_error_budget)
+        # incident plane: the verdicts this stats pass just computed
+        # become flight-recorder triggers (dedup windows make the
+        # heartbeat-cadence re-evaluation safe), and the incident_*/
+        # alert_* block rides the same stats surface to /metrics
+        rec = self.incidents
+        if rec is not None:
+            slo = out.get("serve_slo")
+            if slo and slo.get("exhausted"):
+                rec.record("slo_exhausted", "critical",
+                           trigger={"slo": slo})
+            q = out.get("serve_quality")
+            if q and q.get("exhausted"):
+                rec.record("quality_drift", "critical",
+                           trigger={"quality": q})
+            out.update(rec.stats())
         return out
 
     def heartbeat_sample(self) -> dict:
@@ -1447,6 +1484,7 @@ class InferenceEngine:
             # stop the verifier before the ledger flush: an in-progress
             # verification finishes (its row lands), queued-but-unstarted
             # ones stay pending (visible as exec_deep_verify_pending)
+            self._deep_verify_stop.set()  # skip any pacing wait
             self._deep_verify_q.put(None)
             self._deep_verify_thread.join(timeout=30.0)
         if self._ledger is not None:

@@ -68,6 +68,7 @@ from types import SimpleNamespace
 
 from ..core import supervise
 from ..core.config import ExperimentConfig
+from ..obs import incident
 from ..core.supervise import wait_for_listen  # noqa: F401 - re-export:
 #   tests/conftest.py and the chaos suites import it from here; the
 #   canonical definition moved to the shared supervisor core
@@ -146,6 +147,13 @@ class Fleet:
         # scale-down hook (run_fleet wires the router's map aging):
         # called with the retired slot's idx AFTER the replica is gone
         self.on_retired = None
+        # incident plane (obs/incident.py): run_fleet installs the
+        # supervisor's recorder. Triggers fire inside the locked state
+        # machine, so they queue here and _drain_incidents captures
+        # them AFTER the fleet lock is released (capture does disk I/O
+        # and the lock discipline above forbids I/O under it).
+        self.incidents = None
+        self._pending_incidents: list[tuple[str, str, dict]] = []
         self._monitor = threading.Thread(target=self._run, daemon=True,
                                          name="fleet-monitor")
 
@@ -318,6 +326,7 @@ class Fleet:
         with self._lock:
             to_spawn = [r for r in self._replicas
                         if self._check(r, now, listening, heartbeats)]
+        self._drain_incidents()
         for r in to_spawn:
             self._spawn(r)
 
@@ -379,6 +388,10 @@ class Fleet:
                                           self.fc.crash_loop_threshold):
                     r.state = "broken"
                     self._counters["broken"] += 1
+                    self._queue_incident(
+                        "fleet_broken", "critical",
+                        {"replica": r.idx,
+                         "fast_failures": r.fast_failures})
                     self._log_event(r, "circuit breaker OPEN: "
                                        f"{r.fast_failures} consecutive fast "
                                        "failures, not respawning")
@@ -392,9 +405,32 @@ class Fleet:
         return supervise.read_heartbeat(self._replica_dir(r))
 
     # --------------------------------------------------- state changes
+    def _queue_incident(self, kind: str, severity: str,
+                        trigger: dict) -> None:
+        """Stage a trigger while the fleet lock is held; _poll_all /
+        close capture it unlocked (the recorder writes a disk bundle)."""
+        if self.incidents is not None:
+            self._pending_incidents.append((kind, severity, trigger))
+
+    def _drain_incidents(self) -> None:
+        """Capture staged triggers (fleet lock NOT held), then sweep
+        replica-recorded bundles into the run root so one `tail
+        --fleet` / `incidents list` at the run dir sees the whole
+        fleet — including bundles a SIGKILLed replica left behind."""
+        rec = self.incidents
+        if rec is None:
+            return
+        with self._lock:
+            pending, self._pending_incidents = self._pending_incidents, []
+        for kind, severity, trigger in pending:
+            rec.record(kind, severity, trigger=trigger)
+        rec.note_collected(incident.collect_from_children(self.dir))
+
     def _evict(self, r: _Replica, reason: str, now: float) -> None:
         """Sick replica out of rotation: SIGTERM (graceful drain),
         SIGKILL after term_grace_s (the terminating-state poll)."""
+        self._queue_incident("fleet_eviction", "critical",
+                            {"replica": r.idx, "reason": reason})
         self._counters["evictions"] += 1
         if reason in ("wedged", "stalled"):  # both are stuck dispatches
             self._counters["wedge_evictions"] += 1
@@ -428,6 +464,8 @@ class Fleet:
         else:
             self._counters["crashes"] += 1
             self._counters["evictions"] += 1
+            self._queue_incident("fleet_replica_crash", "critical",
+                                {"replica": r.idx, "rc": rc})
         r.last_reason = reason
         self._log_event(r, f"died ({reason}, rc={rc}); scheduling respawn")
         self._schedule_backoff(r, clean=clean)
@@ -694,6 +732,9 @@ class Fleet:
         # roots discipline as retire-time GC; replicas' ledgers are
         # complete now, so the pin set is the whole run's lattice)
         self._artifacts_gc("close")
+        # final incident pass: staged triggers captured, every
+        # replica-recorded bundle collected before the run dir is read
+        self._drain_incidents()
 
     def __enter__(self) -> "Fleet":
         return self
@@ -731,6 +772,11 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
     from .router import Router, build_router_server
 
     fleet = Fleet(cfg, replicas)
+    # supervisor-process flight recorder (obs/incident.py): evictions /
+    # broken replicas / crashes and the router's SLO verdict all record
+    # into the RUN ROOT's incidents/, where the monitor also collects
+    # each replica's own bundles. None when obs.incidents is off.
+    fleet.incidents = incident.install(cfg, cfg.train.log_dir, "fleet")
     router = None
     httpd = None
     hb = None
@@ -750,6 +796,7 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
             print(f"fleet: no replica became ready: {e}", file=sys.stderr)
             return 1
         router = Router(cfg, fleet)
+        router.incidents = fleet.incidents
         # scale-down aging: a retired slot leaves the router's
         # per-replica maps; its pinned sessions demote to session_lost
         fleet.on_retired = router.retire_slot
@@ -774,11 +821,21 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
                 hb_ref["hb"].touch()
             return s
 
+        sample_fn = sample
+        if fleet.incidents is not None:
+            # alert rules + heartbeat ring on the sample cadence; a
+            # wedged SUPERVISOR is itself a critical incident
+            sample_fn = fleet.incidents.wrap_sample(sample)
         hb = Heartbeat(os.path.join(cfg.train.log_dir, "heartbeat.json"),
                        period_s=cfg.obs.heartbeat_period_s,
                        watchdog_factor=cfg.obs.watchdog_factor,
                        watchdog_min_s=cfg.obs.watchdog_min_s,
-                       sample=sample, devmem=False)  # supervisor: jax-free
+                       sample=sample_fn,
+                       on_wedge=(None if fleet.incidents is None else
+                                 lambda dump: fleet.incidents.record(
+                                     "watchdog_wedge", "critical",
+                                     text_files={"stacks.txt": dump})),
+                       devmem=False)  # supervisor: jax-free
         hb_ref["hb"] = hb
         router.beat_hook = hb.beat
 

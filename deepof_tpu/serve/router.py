@@ -155,6 +155,11 @@ class Router:
         # called with the cumulative response count after each success —
         # the fleet heartbeat's beat() (run_fleet wires it)
         self.beat_hook: Callable[[int], None] | None = None
+        # incident plane (obs/incident.py): run_fleet installs the
+        # supervisor-process recorder here; stats() raises the
+        # fleet-SLO exhaustion trigger and carries the incident_*
+        # block to /healthz, /metrics and the fleet heartbeat
+        self.incidents = None
         # the autoscaler's fleet_autoscale_* block (run_fleet wires
         # Autoscaler.stats when fleet.autoscale): merged into stats()
         # so scale counters ride /healthz, /metrics and the heartbeat
@@ -702,6 +707,16 @@ class Router:
             out["fleet_slo"] = slo_state(hist, requests, failures,
                                          self.cfg.obs.slo_latency_ms,
                                          self.cfg.obs.slo_error_budget)
+        rec = self.incidents
+        if rec is not None:
+            slo = out.get("fleet_slo")
+            if slo and slo.get("exhausted"):
+                # the router's budget is the FLEET's contract — its
+                # exhaustion is a supervisor-level incident (dedup
+                # window absorbs the heartbeat-cadence re-check)
+                rec.record("slo_exhausted", "critical",
+                           trigger={"slo": slo})
+            out.update(rec.stats())
         return out
 
     # ---------------------------------------------------------- /metrics

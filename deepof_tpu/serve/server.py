@@ -65,6 +65,7 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from ..core.config import ExperimentConfig
 from ..io.flo import flo_bytes
+from ..obs import incident
 from ..obs import trace as obs_trace
 from ..obs.export import PROM_CONTENT_TYPE, render_prometheus
 from .engine import InferenceEngine, ServeError
@@ -373,6 +374,15 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
         if own_engine:
             engine = InferenceEngine(cfg, model_params=model_params)
         install_replica_faults(engine, cfg)
+        # incident plane (obs/incident.py): the replica's flight
+        # recorder. The engine raises its own triggers (SLO/quality
+        # exhaustion, deep-verify demote) through this handle; the
+        # watchdog wedge is wired below; None (obs.incidents off) keeps
+        # every site a structural no-op.
+        incidents = incident.install(
+            cfg, cfg.train.log_dir,
+            "replica" if os.environ.get(REPLICA_ENV) else "serve")
+        engine.incidents = incidents
         warm = engine.warm()
 
         # serve heartbeat: flushes are the "steps"; with NO work in
@@ -391,11 +401,20 @@ def run_server(cfg: ExperimentConfig, engine: InferenceEngine | None = None,
                 hb_ref["hb"].touch()
             return s
 
+        if incidents is not None:
+            # alert rules + heartbeat ring ride the sample cadence; the
+            # watchdog wedge becomes a critical incident carrying the
+            # firing-time stack dump
+            sample = incidents.wrap_sample(sample)
         hb = Heartbeat(os.path.join(cfg.train.log_dir, "heartbeat.json"),
                        period_s=cfg.obs.heartbeat_period_s,
                        watchdog_factor=cfg.obs.watchdog_factor,
                        watchdog_min_s=cfg.obs.watchdog_min_s,
                        sample=sample,
+                       on_wedge=(None if incidents is None else
+                                 lambda dump: incidents.record(
+                                     "watchdog_wedge", "critical",
+                                     text_files={"stacks.txt": dump})),
                        # a fake-executor replica stays jax-free end to end
                        devmem=cfg.serve.fake_exec_ms is None)
         hb_ref["hb"] = hb

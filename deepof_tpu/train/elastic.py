@@ -99,6 +99,7 @@ import time
 
 from ..core import supervise
 from ..core.config import ExperimentConfig
+from ..obs import incident
 from ..resilience import verify as ckpt_verify
 
 #: Trainer-host lifecycle states (ElasticCoordinator._check_host is the
@@ -309,6 +310,7 @@ class ElasticCoordinator:
         self._reform_started: float | None = None
         self._stopping = False
         self.beat_hook = None  # set by run_elastic: (step) -> None
+        self.incidents = None  # set by run_elastic (obs/incident.py)
         self.world_path = os.path.join(self.dir, "elastic_world.json")
         self._last_poll_m = time.monotonic()
 
@@ -390,10 +392,16 @@ class ElasticCoordinator:
                 return 0
             lost = self._poll()
             self._last_poll_m = time.monotonic()
+            self._sweep_incidents()
             if lost:
                 if self._counters["reforms"] >= int(self.ec.max_reforms):
                     self._log(f"giving up: {self.ec.max_reforms} re-forms "
                               "exhausted and another host was lost")
+                    self._record_incident(
+                        "elastic_abort", "critical",
+                        {"reason": "max_reforms exhausted",
+                         "reforms": self._counters["reforms"],
+                         "lost": sorted(h.idx for h in lost)})
                     self._stop_world("max_reforms exhausted")
                     return 1
                 self._reform(lost)
@@ -414,9 +422,18 @@ class ElasticCoordinator:
                               f"{self._newest_ckpt_step(valid_only=True)}"
                               f" < {self.target} (primary lost or torn "
                               "final save); failing the run")
+                    self._record_incident(
+                        "elastic_abort", "critical",
+                        {"reason": "lineage below target",
+                         "target": self.target})
                     return 1
                 self._log("all hosts terminal below the target step "
                           f"{self.target}; aborting")
+                self._record_incident(
+                    "elastic_abort", "critical",
+                    {"reason": "all hosts terminal below target",
+                     "target": self.target,
+                     "max_step_seen": self.max_step_seen})
                 return 1
             time.sleep(max(float(self.ec.poll_s), 0.05))
 
@@ -551,6 +568,12 @@ class ElasticCoordinator:
                   f"{sorted(h.idx for h in lost)} "
                   f"({'; '.join(h.last_reason or '?' for h in lost)}); "
                   f"{len(survivors)} survivor(s); barrier SIGTERM")
+        self._record_incident(
+            "elastic_reform", "warn",
+            {"generation": self.generation,
+             "lost": sorted(h.idx for h in lost),
+             "reasons": sorted({h.last_reason or "?" for h in lost}),
+             "survivors": len(survivors)})
         self._barrier(survivors)
         self.resumed_step = self._newest_ckpt_step()
         stride = max(int(self.cfg.train.steps_per_call), 1)
@@ -649,6 +672,23 @@ class ElasticCoordinator:
         hung in a re-form or a filesystem walk eventually trips its
         wedge watchdog instead of reporting healthy forever)."""
         return time.monotonic() - self._last_poll_m
+
+    def _record_incident(self, kind: str, severity: str = "warn",
+                         trigger: dict | None = None) -> None:
+        """Flight-recorder trigger (obs/incident.py); no-op unless
+        run_elastic installed a recorder. The coordinator is
+        single-threaded, so captures run inline (no lock to shed,
+        unlike the fleet's pending-queue)."""
+        if self.incidents is not None:
+            self.incidents.record(kind, severity, trigger=trigger)
+
+    def _sweep_incidents(self) -> None:
+        """Move committed bundles out of host-<i>/incidents/ into the
+        run root (the fleet supervisor runs the same sweep): one triage
+        surface per run, each bundle counted exactly once."""
+        rec = self.incidents
+        if rec is not None:
+            rec.note_collected(incident.collect_from_children(self.dir))
 
     def _mark_lost(self, h: _TrainerHost, reason: str) -> None:
         self._counters["lost_hosts"] += 1
@@ -765,6 +805,7 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
     from ..obs.heartbeat import Heartbeat
 
     coord = ElasticCoordinator(cfg, hosts=hosts, target_step=max_steps)
+    coord.incidents = incident.install(cfg, coord.dir, "elastic")
     hb = None
     metrics_srv = None
     rc = 1
@@ -795,11 +836,21 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
                 hb_ref["hb"].touch()
             return s
 
+        sample_fn = sample
+        if coord.incidents is not None:
+            # observe each sample (alert rules + last-K ring) and merge
+            # the incident_*/alert_* counters into the heartbeat block
+            sample_fn = coord.incidents.wrap_sample(sample)
         hb = Heartbeat(os.path.join(coord.dir, "heartbeat.json"),
                        period_s=cfg.obs.heartbeat_period_s,
                        watchdog_factor=cfg.obs.watchdog_factor,
                        watchdog_min_s=cfg.obs.watchdog_min_s,
-                       sample=sample, devmem=False)  # supervisor: jax-free
+                       sample=sample_fn,
+                       on_wedge=(None if coord.incidents is None else
+                                 lambda dump: coord.incidents.record(
+                                     "watchdog_wedge", "critical",
+                                     text_files={"stacks.txt": dump})),
+                       devmem=False)  # supervisor: jax-free
         hb_ref["hb"] = hb
         coord.beat_hook = hb.beat
 
@@ -826,6 +877,7 @@ def run_elastic(cfg: ExperimentConfig, hosts: int | None = None,
         return rc
     finally:
         coord.close()  # every exit path: no orphaned trainer sessions
+        coord._sweep_incidents()  # children are dead: final collection
         coord._write_record()
         if metrics_srv is not None:
             metrics_srv.shutdown()

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..core.config import ExperimentConfig
 from ..data import InputPipeline, Prefetcher, build_dataset, derive_batch_rng
 from ..models.registry import build_model
+from ..obs import incident as obs_incident
 from ..obs import trace as obs_trace
 from ..obs.heartbeat import Heartbeat
 from ..obs.ledger import ExecutableLedger
@@ -564,6 +565,12 @@ class Trainer:
         ledger = (ExecutableLedger(cfg.train.log_dir,
                                    backend=jax.default_backend())
                   if cfg.obs.ledger and primary else None)
+        # Incident flight recorder (obs/incident.py): NaN rollbacks,
+        # quarantine exhaustion, and watchdog wedges snapshot a bounded
+        # diagnostic bundle; off (and structurally absent) by default.
+        incidents = (obs_incident.install(cfg, cfg.train.log_dir,
+                                          "trainer")
+                     if primary else None)
         heartbeat = None
         if cfg.obs.heartbeat and primary:
 
@@ -578,15 +585,21 @@ class Trainer:
                         **resilience_stats(),
                         **(ledger.stats() if ledger is not None else {})}
 
+            sample_fn = (_hb_sample if incidents is None
+                         else incidents.wrap_sample(_hb_sample))
             try:
                 heartbeat = Heartbeat(
                     os.path.join(cfg.train.log_dir, "heartbeat.json"),
                     period_s=cfg.obs.heartbeat_period_s,
                     watchdog_factor=cfg.obs.watchdog_factor,
                     watchdog_min_s=cfg.obs.watchdog_min_s,
-                    sample=_hb_sample,
+                    sample=sample_fn,
                     log=lambda s, m: self.logger.log("warn", s, message=m),
-                    tracer=tracer)
+                    tracer=tracer,
+                    on_wedge=(None if incidents is None else
+                              lambda dump: incidents.record(
+                                  "watchdog_wedge", "critical",
+                                  text_files={"stacks.txt": dump})))
             except BaseException:  # same leak guard as above
                 fetcher.close()
                 pipeline.close()
@@ -903,6 +916,11 @@ class Trainer:
                     streak["ok"] = False
                     skip_state["streak"] = 0  # the rollback rewinds the run
                     timer.count("rollbacks")
+                    if incidents is not None:
+                        incidents.record(
+                            "nan_rollback",
+                            trigger={"nan_step": nan_step,
+                                     "consecutive": consecutive_nans + 1})
                     self._rollback(nan_step)
                     gstep = int(self.state.step)
                     # discarded steps must not count toward throughput
@@ -915,6 +933,11 @@ class Trainer:
                         heartbeat.touch()  # restore device_puts took time
                     consecutive_nans += 1
                     if consecutive_nans >= 3:
+                        if incidents is not None:
+                            incidents.record(
+                                "nan_quarantine_exhausted", "critical",
+                                trigger={"step": gstep,
+                                         "consecutive": consecutive_nans})
                         raise FloatingPointError(
                             f"loss diverged to NaN {consecutive_nans} "
                             f"consecutive times around step {gstep}; "

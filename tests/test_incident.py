@@ -1,0 +1,530 @@
+"""Incident plane (obs/incident.py + CLI + tail rc 9) — ISSUE 18.
+
+Unit tier (jax-free): manifest schema pin, atomic-commit torn-bundle
+contract, dedup/rate-limit bounds, alert-rule grammar, offline
+(rc-8 ledger drift) structural dedup, supervisor collection, the
+`incidents` CLI rc contract, and the obs.incidents=false structural
+no-op.
+
+Chaos tier (subprocess replicas, fake timed executor): a 2-replica
+fleet with an injected SLO exhaustion and one replica SIGKILL — each
+anomaly commits exactly ONE schema-valid bundle into the run root,
+repeats dedup, `incidents list` exits 1 and `tail --fleet` exits 9
+until `incidents ack` clears them.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from conftest import wait_for_listen  # noqa: F401 - path side effect
+
+from deepof_tpu.core.config import get_config
+from deepof_tpu.obs import incident
+
+# ----------------------------------------------------------- helpers
+
+
+def _mk_run(tmp_path, name="run"):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    return str(d)
+
+
+#: The frozen bundle manifest schema — a consumer (triage tooling,
+#: dashboards) may rely on every key below existing in every committed
+#: bundle. Extending the schema = bump SCHEMA_VERSION + extend here.
+MANIFEST_KEYS = {
+    "schema", "id", "kind", "severity", "role", "pid", "seq", "time",
+    "iso_time", "trigger", "counters", "dedup_key", "config_digest",
+    "registry_digest", "files", "origin",
+}
+
+
+# ------------------------------------------------------ manifest pin
+
+
+def test_manifest_schema_pin(tmp_path):
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "trainer")
+    path = rec.record("nan_rollback", trigger={"step": 7})
+    assert path is not None and os.path.isdir(path)
+    mans = incident.list_incidents(d)
+    assert len(mans) == 1
+    man = mans[0]
+    # list_incidents annotates id + acked on top of the stored schema
+    assert set(man) == MANIFEST_KEYS | {"acked"}
+    assert man["schema"] == incident.SCHEMA_VERSION == 1
+    assert man["kind"] == "nan_rollback"
+    assert man["severity"] == "warn"
+    assert man["role"] == "trainer"
+    assert man["trigger"] == {"step": 7}
+    assert man["dedup_key"] == "nan_rollback"
+    assert man["origin"] is None and man["acked"] is False
+    # the bundle always carries a stack dump, and every inventoried
+    # file exists on disk at its recorded size
+    assert "stacks.txt" in man["files"]
+    for fname, size in man["files"].items():
+        p = os.path.join(path, fname)
+        assert os.path.isfile(p) and os.path.getsize(p) == size
+    # counters snapshot the recorder state at capture time
+    assert man["counters"]["incident_captured"] == 0
+
+
+def test_bundle_carries_log_tails_and_heartbeat_ring(tmp_path):
+    d = _mk_run(tmp_path)
+    with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+        for i in range(500):
+            f.write(json.dumps({"kind": "train", "step": i}) + "\n")
+    rec = incident.IncidentRecorder(d, "trainer", metrics_tail=10,
+                                    heartbeats=3)
+    for i in range(5):  # ring keeps the newest 3
+        rec.observe({"step": i})
+    path = rec.record("watchdog_wedge", "critical",
+                      text_files={"stacks.txt": "fake dump"})
+    with open(os.path.join(path, "metrics_tail.jsonl")) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 10
+    assert json.loads(lines[-1])["step"] == 499
+    with open(os.path.join(path, "heartbeats.jsonl")) as f:
+        steps = [json.loads(x)["step"] for x in f.read().splitlines()]
+    assert steps == [2, 3, 4]
+    with open(os.path.join(path, "stacks.txt")) as f:
+        assert f.read() == "fake dump"
+
+
+# -------------------------------------------------- atomic commit
+
+
+def test_atomic_commit_torn_capture_leaves_no_bundle(tmp_path,
+                                                     monkeypatch):
+    """A process killed mid-capture must never leave a half bundle that
+    triage reads: the manifest is written last inside a staging dir and
+    the rename is the commit. Simulated by dying right before the
+    rename."""
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "serve")
+
+    def boom(src, dst):
+        raise OSError("killed mid-capture")
+
+    monkeypatch.setattr(incident.os, "rename", boom)
+    assert rec.record("slo_exhausted", "critical") is None
+    assert rec.stats()["incident_capture_errors"] == 1
+    monkeypatch.undo()
+    # nothing committed: list sees no incident; the summary surfaces
+    # the tear as `torn` (never as a triageable incident), and gc
+    # removes the orphaned staging dir
+    assert incident.list_incidents(d) == []
+    summ = incident.incident_summary(d)
+    assert summ["total"] == 0 and summ["unacked_critical"] == 0
+    assert summ["torn"] == 1
+    report = incident.gc_incidents(d)
+    assert report["staging_removed"] == 1 and report["removed"] == []
+    assert os.listdir(incident.incidents_dir(d)) == []
+
+
+# ------------------------------------------- dedup / rate limiting
+
+
+def test_dedup_window_and_distinct_keys(tmp_path):
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "serve", dedup_window_s=300.0,
+                                    burst=10)
+    assert rec.record("slo_exhausted", "critical") is not None
+    assert rec.record("slo_exhausted", "critical") is None  # deduped
+    # a distinct kind (or explicit dedup key) is its own window
+    assert rec.record("quality_drift", "critical") is not None
+    assert rec.record("quality_drift", "critical",
+                      dedup_key="other") is not None
+    s = rec.stats()
+    assert s["incident_captured"] == 3 and s["incident_deduped"] == 1
+    assert s["incident_by_kind"] == {"slo_exhausted": 1,
+                                     "quality_drift": 2}
+
+
+def test_token_bucket_bounds_distinct_kind_storm(tmp_path):
+    """A storm of DISTINCT kinds passes every dedup window — the global
+    token bucket must still bound captures to the configured burst."""
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "serve", rate_per_min=0.0001,
+                                    burst=3)
+    results = [rec.record(f"kind_{i}") for i in range(10)]
+    committed = [r for r in results if r]
+    assert len(committed) == 3
+    s = rec.stats()
+    assert s["incident_captured"] == 3
+    assert s["incident_rate_limited"] == 7
+    assert len(incident.list_incidents(d)) == 3
+
+
+def test_keep_bound_prunes_oldest(tmp_path):
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "serve", dedup_window_s=0.0,
+                                    rate_per_min=1e9, burst=100, keep=4)
+    for i in range(8):
+        assert rec.record(f"k{i}") is not None
+    mans = incident.list_incidents(d)
+    assert [m["kind"] for m in mans] == ["k4", "k5", "k6", "k7"]
+
+
+def test_record_never_raises(tmp_path):
+    """The flight recorder must never kill its trigger site: captures
+    into an unwritable root count an error and return None."""
+    d = _mk_run(tmp_path)
+    blocker = os.path.join(d, incident.INCIDENTS_DIRNAME)
+    with open(blocker, "w") as f:  # a FILE where the dir must go
+        f.write("x")
+    rec = incident.IncidentRecorder(d, "serve")
+    assert rec.record("slo_exhausted", "critical") is None
+    assert rec.stats()["incident_capture_errors"] == 1
+
+
+# ----------------------------------------------------- alert engine
+
+
+def test_alert_rules_parse_fire_and_reject(tmp_path):
+    d = _mk_run(tmp_path)
+    rec = incident.IncidentRecorder(d, "serve", alerts=(
+        "serve_errors > 0 critical",
+        "quiet: rate(serve_requests) < 0 warn",  # a rate can't: inert
+    ))
+    rec.observe({"serve_errors": 0, "serve_requests": 0})
+    rec.observe({"serve_errors": 2, "serve_requests": 1})
+    s = rec.stats()
+    assert s["alert_rules"] == 2
+    assert s["alert_firings"] == 1 and s["alert_errors"] == 0
+    mans = incident.list_incidents(d)
+    assert [m["kind"] for m in mans] == ["alert_serve_errors"]
+    assert mans[0]["severity"] == "critical"
+    assert mans[0]["trigger"]["value"] == 2.0
+    # re-firing on the next sample is absorbed by the dedup window
+    rec.observe({"serve_errors": 3, "serve_requests": 2})
+    assert rec.stats()["alert_firings"] == 2
+    assert len(incident.list_incidents(d)) == 1
+
+    # malformed / unregistered / duplicate rules fail LOUDLY at install
+    for bad in ("unregistered_counter > 1",
+                "serve_errors >> 3",
+                "serve_errors > nan_text",
+                "serve_errors = 3"):
+        with pytest.raises(ValueError):
+            incident.parse_alert_rules((bad,))
+    with pytest.raises(ValueError):
+        incident.parse_alert_rules(("serve_errors > 1",
+                                    "serve_errors < 5"))
+
+
+def test_alert_rate_rule_uses_per_second_delta(tmp_path):
+    d = _mk_run(tmp_path)
+    rules = incident.parse_alert_rules(("hot: rate(serve_requests) > 5",))
+    (rule,) = rules
+    fired, value = rule.evaluate({"serve_requests": 100}, None, 10.0)
+    assert not fired and value is None  # no previous sample: no rate
+    prev = (10.0, {"serve_requests": 100})
+    fired, value = rule.evaluate({"serve_requests": 130}, prev, 12.0)
+    assert fired and value == 15.0
+
+
+# ------------------------------------------------- offline recording
+
+
+def test_record_offline_structural_dedup(tmp_path):
+    d = _mk_run(tmp_path)
+    key = json.dumps({"fingerprint_drift": ["serve_infer_b1"]})
+    assert incident.record_offline(d, "ledger_drift", "critical",
+                                   trigger={"x": 1},
+                                   dedup_key=key) is not None
+    # same verdict again (a tail --follow re-check): suppressed by the
+    # EXISTING bundle, not by in-memory state
+    assert incident.record_offline(d, "ledger_drift", "critical",
+                                   dedup_key=key) is None
+    # a DIFFERENT condensed verdict is a new regression: new bundle
+    assert incident.record_offline(d, "ledger_drift", "critical",
+                                   dedup_key="other") is not None
+    assert len(incident.list_incidents(d)) == 2
+
+
+# -------------------------------------------- supervisor collection
+
+
+def test_collect_from_children_moves_once_and_annotates(tmp_path):
+    run = _mk_run(tmp_path)
+    child = os.path.join(run, "replica-0")
+    os.makedirs(child)
+    crec = incident.IncidentRecorder(child, "replica")
+    cpath = crec.record("quality_drift", "critical")
+    assert cpath is not None
+    # a torn staging dir in the child must NOT be collected
+    os.makedirs(os.path.join(child, incident.INCIDENTS_DIRNAME,
+                             f"{incident.STAGING_PREFIX}999-1"))
+    assert incident.collect_from_children(run) == 1
+    assert incident.collect_from_children(run) == 0  # moved, not copied
+    assert incident.list_incidents(child) == []
+    mans = incident.list_incidents(run)
+    assert len(mans) == 1
+    assert mans[0]["origin"] == "replica-0"
+    assert mans[0]["id"].startswith("replica-0--")
+    assert mans[0]["kind"] == "quality_drift"
+    summ = incident.incident_summary(run)
+    assert summ["unacked_critical"] == 1
+
+
+# ----------------------------------------------- structural no-op
+
+
+def test_disabled_is_structural_noop(tmp_path):
+    d = _mk_run(tmp_path)
+    cfg = get_config("flyingchairs")
+    assert cfg.obs.incidents is False  # default OFF
+    assert incident.install(cfg, d, "serve") is None
+    assert incident.install(
+        cfg.replace(obs=dataclasses.replace(cfg.obs, incidents=True)),
+        None, "serve") is None  # no log dir: still no recorder
+    on = incident.install(
+        cfg.replace(obs=dataclasses.replace(cfg.obs, incidents=True)),
+        d, "serve")
+    assert on is not None and on.role == "serve"
+    # with nothing recorded, analyze/tail summaries omit the block
+    # entirely (no incidents/ dir is ever created eagerly)
+    from deepof_tpu.analyze import tail_summary
+
+    with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "train", "step": 1, "loss": 1.0,
+                            "time": 1.0}) + "\n")
+    assert "incidents" not in tail_summary(d)
+    assert not os.path.isdir(incident.incidents_dir(d))
+
+
+# --------------------------------------------------- CLI rc contract
+
+
+def test_cli_incidents_rc_contract(tmp_path, capsys):
+    """`incidents` is jax-free triage with the artifacts/verify-ckpt rc
+    family: 0 = healthy, 1 = unacked CRITICAL bundles, 2 = none."""
+    from deepof_tpu.cli import main as cli_main
+
+    d = _mk_run(tmp_path)
+    assert cli_main(["incidents", "list", "--log-dir", d]) == 2
+
+    rec = incident.IncidentRecorder(d, "serve", dedup_window_s=0.0)
+    rec.record("nan_rollback")  # warn only: healthy
+    assert cli_main(["incidents", "list", "--log-dir", d]) == 0
+    path = rec.record("slo_exhausted", "critical")
+    bid = os.path.basename(path)
+    capsys.readouterr()  # drop the earlier calls' output
+    assert cli_main(["incidents", "list", "--log-dir", d]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"]["unacked_critical"] == 1
+    assert [r["id"] for r in out["incidents"]][-1] == bid
+
+    # show: full manifest + on-disk inventory; unknown id is rc 1
+    capsys.readouterr()
+    assert cli_main(["incidents", "show", "--log-dir", d,
+                     "--id", bid]) == 0
+    detail = json.loads(capsys.readouterr().out)
+    assert detail["kind"] == "slo_exhausted"
+    assert "stacks.txt" in detail["files_on_disk"]
+    assert cli_main(["incidents", "show", "--log-dir", d,
+                     "--id", "nope"]) == 1
+
+    # ack clears the rc-1 (and tail's rc-9) condition
+    capsys.readouterr()
+    assert cli_main(["incidents", "ack", "--log-dir", d,
+                     "--id", bid]) == 0
+    acked = json.loads(capsys.readouterr().out)["acked"]
+    assert acked == [bid]
+    assert cli_main(["incidents", "list", "--log-dir", d]) == 0
+
+    # gc --acked removes the acknowledged bundle, keeps the warn one
+    capsys.readouterr()
+    assert cli_main(["incidents", "gc", "--log-dir", d, "--acked"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["removed"] == [bid] and report["kept"] == 1
+    assert [m["kind"] for m in incident.list_incidents(d)] \
+        == ["nan_rollback"]
+
+
+def test_tail_rc9_outranks_other_verdicts(tmp_path, capsys):
+    """rc 9 is FIRST in tail's ladder: the bundle carries the
+    underlying verdict, and `incidents ack` moves triage past it where
+    cumulative counters would re-fire forever."""
+    from deepof_tpu.cli import main as cli_main
+
+    d = _mk_run(tmp_path)
+    with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "train", "step": 1, "loss": 1.0,
+                            "time": 1.0}) + "\n")
+    assert cli_main(["tail", "--log-dir", d]) == 0
+    rec = incident.IncidentRecorder(d, "trainer", dedup_window_s=0.0)
+    rec.record("nan_rollback")  # warn: tail stays healthy
+    assert cli_main(["tail", "--log-dir", d]) == 0
+    rec.record("nan_quarantine_exhausted", "critical")
+    assert cli_main(["tail", "--log-dir", d]) == 9
+    assert json.loads(
+        capsys.readouterr().out.splitlines()[-1]
+    )["incidents"]["unacked_critical"] == 1
+    assert cli_main(["incidents", "ack", "--log-dir", d]) == 0
+    assert cli_main(["tail", "--log-dir", d]) == 0
+
+
+# --------------------------------------------- chaos (subprocess)
+
+
+def _b64png(rng, hw=(30, 60)):
+    import base64
+
+    import cv2
+    import numpy as np
+
+    ok, buf = cv2.imencode(
+        ".png", rng.randint(1, 255, (*hw, 3), dtype=np.uint8))
+    assert ok
+    return base64.b64encode(buf.tobytes()).decode()
+
+
+def _post(port, body, path="/v1/flow", timeout=30.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.mark.chaos
+def test_incident_chaos_sigkill_and_slo_exhaustion(rng, tmp_path):
+    """ISSUE 18 acceptance drill: a 2-replica fleet (fake timed
+    executor) with obs.incidents on. An injected SLO exhaustion
+    (impossible latency target) and one replica SIGKILL each commit
+    exactly ONE schema-valid bundle into the run root; repeats dedup;
+    replica-side bundles are collected (moved) into the run root;
+    `incidents list` exits 1 and `tail --fleet` exits 9 until
+    `incidents ack` clears them — after which the underlying rc-4
+    eviction counters surface again."""
+    from deepof_tpu.cli import main as cli_main
+    from deepof_tpu.core import supervise
+    from deepof_tpu.obs.heartbeat import Heartbeat
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+    from conftest import wait_for_listen as _wfl
+
+    fleet_dir = tmp_path / "fleet"
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        serve=dataclasses.replace(
+            cfg.serve, max_batch=4, batch_timeout_ms=5.0, buckets=(),
+            fake_exec_ms=5.0, host="127.0.0.1", port=0,
+            fleet=dataclasses.replace(
+                cfg.serve.fleet, poll_s=0.1, stale_after_s=5.0,
+                stall_after_s=2.0, spawn_timeout_s=90.0, term_grace_s=1.0,
+                backoff_s=0.1, backoff_max_s=0.5, healthy_after_s=30.0,
+                proxy_timeout_s=2.0, max_in_flight=64,
+                drain_timeout_s=2.0)),
+        train=dataclasses.replace(cfg.train, log_dir=str(fleet_dir)),
+        obs=dataclasses.replace(
+            cfg.obs, heartbeat_period_s=0.1, watchdog_min_s=3600.0,
+            incidents=True,
+            # injected SLO exhaustion: a 5ms fake executor can never
+            # meet 0.001ms, so the first admitted request burns the
+            # whole error budget
+            slo_latency_ms=0.001, slo_error_budget=0.01))
+
+    bodies = [json.dumps({"prev": _b64png(rng), "next": _b64png(rng)})
+              .encode() for _ in range(2)]
+    with Fleet(cfg, 2) as fleet:
+        fleet.incidents = incident.install(cfg, str(fleet_dir), "fleet")
+        assert fleet.incidents is not None
+        fleet.start()
+        fleet.wait_ready(min_ready=2, timeout_s=120)
+        router = Router(cfg, fleet)
+        router.incidents = fleet.incidents
+        httpd = build_router_server(cfg, router)
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="incident-router").start()
+        port = httpd.server_address[1]
+        _wfl("127.0.0.1", port)
+        hb = Heartbeat(str(fleet_dir / "heartbeat.json"), period_s=0.1,
+                       watchdog_min_s=3600.0,
+                       sample=fleet.incidents.wrap_sample(
+                           lambda: {**fleet.stats(), **router.stats()}),
+                       devmem=False)
+        try:
+            for i in range(12):
+                status, _ = _post(port, bodies[i % 2])
+                assert status == 200
+            # the router's stats pass records the slo_exhausted
+            # incident; heartbeat-cadence re-checks dedup against it
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if fleet.incidents.stats()["incident_by_kind"].get(
+                        "slo_exhausted"):
+                    break
+                time.sleep(0.1)
+            s = fleet.incidents.stats()
+            assert s["incident_by_kind"].get("slo_exhausted") == 1, s
+
+            # SIGKILL replica 0 (pid from its own live heartbeat): the
+            # supervisor observes the crash and commits the bundle
+            rhb = supervise.read_heartbeat(str(fleet_dir / "replica-0"))
+            os.kill(int(rhb["pid"]), signal.SIGKILL)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                s = fleet.incidents.stats()
+                if (s["incident_by_kind"].get("fleet_replica_crash")
+                        and s["incident_deduped"] >= 1
+                        and s["incident_collected"] >= 1):
+                    break
+                time.sleep(0.2)
+            stats = fleet.stats()
+            s = fleet.incidents.stats()
+        finally:
+            hb.close()
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+
+    assert stats["fleet_crashes"] >= 1, stats
+    # exactly ONE bundle per anomaly, dedup absorbed the re-checks
+    mans = incident.list_incidents(str(fleet_dir))
+    own = [m for m in mans if m["origin"] is None]
+    assert [m["kind"] for m in own
+            if m["kind"] == "fleet_replica_crash"] \
+        == ["fleet_replica_crash"], mans
+    assert [m["kind"] for m in own if m["kind"] == "slo_exhausted"] \
+        == ["slo_exhausted"], mans
+    assert s["incident_deduped"] >= 1, s
+    for m in own:
+        assert m["schema"] == incident.SCHEMA_VERSION
+        assert m["severity"] == "critical"
+        assert m["role"] == "fleet"
+    # replica-recorded bundles (each replica's own serve_slo verdict)
+    # were MOVED into the run root with their origin annotated
+    collected = [m for m in mans if m["origin"]]
+    assert collected and s["incident_collected"] >= 1, (mans, s)
+    assert all(m["role"] == "replica" for m in collected)
+
+    # the whole drill from the run dir: rc 9 until acked, then the
+    # underlying rc-4 eviction counters surface again
+    assert cli_main(["incidents", "list",
+                     "--log-dir", str(fleet_dir)]) == 1
+    assert cli_main(["tail", "--log-dir", str(fleet_dir),
+                     "--fleet"]) == 9
+    assert cli_main(["incidents", "ack",
+                     "--log-dir", str(fleet_dir)]) == 0
+    assert cli_main(["incidents", "list",
+                     "--log-dir", str(fleet_dir)]) == 0
+    assert cli_main(["tail", "--log-dir", str(fleet_dir),
+                     "--fleet"]) == 4

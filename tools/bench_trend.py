@@ -101,6 +101,14 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
      "lower"),  # noise-centered: flagged via ABS_BOUNDS, not vs best
     ("bench_ledger_compile_s", ("ledger", "compile_s_total"), "lower"),
     ("bench_ledger_mfu", ("ledger", "mfu_nominal"), "higher"),
+    # r18 incident plane (obs/incident.py + serve_bench --incidents):
+    # the flight recorder's hot-path p99 cost with an idle recorder
+    # (bounded <= 1%), and the round's committed-bundle count on the
+    # healthy bench workload — should pin at 0 every round (a nonzero
+    # count means a bench run tripped an anomaly trigger)
+    ("bench_incident_overhead_pct", ("incidents", "p99_overhead_pct"),
+     "lower"),  # noise-centered: flagged via ABS_BOUNDS, not vs best
+    ("bench_incident_captured", ("incidents", "captured"), "lower"),
     ("bench_lint_wall_s", ("lint", "value"), "lower"),
     ("bench_elastic_recovery_s",
      ("elastic_drill", "host_loss", "recovery_wall_s"), "lower"),
@@ -121,6 +129,7 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
 #: absolute acceptance for this series (recorded, never auto-flagged).
 ABS_BOUNDS: dict[str, float | None] = {
     "bench_ledger_overhead_pct": 2.0,       # ISSUE 15: <= 2% of p99
+    "bench_incident_overhead_pct": 1.0,     # ISSUE 18: <= 1% of p99
     "bench_quality_p99_overhead_pct": 5.0,  # ISSUE 13: p99 < 5% at 0.1
     # rps-based companion figure; ISSUE 13's 5% acceptance bounds the
     # P99 overhead, not this one

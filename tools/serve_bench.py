@@ -173,6 +173,17 @@ LEDGER_REQUIRED_KEYS = (
     "p99_ledger_off_ms", "p99_ledger_on_ms", "p99_overhead_pct",
 )
 
+#: keys every --incidents result carries (schema smoke test): the
+#: incident flight recorder's hot-path cost as a p99 pair — identical
+#: seeded workloads with obs.incidents off vs ON with an idle recorder
+#: (alert rules installed, no trigger ever fires; the ISSUE 18
+#: acceptance bounds p99_overhead_pct <= 1)
+INCIDENT_REQUIRED_KEYS = (
+    "mode", "requests", "max_batch", "timeout_ms", "gap_ms", "bucket",
+    "alert_rules", "captured", "rps_incidents_off", "rps_incidents_on",
+    "p99_incidents_off_ms", "p99_incidents_on_ms", "p99_overhead_pct",
+)
+
 #: keys every --quality result carries at the top level (schema smoke
 #: test): per-tier label-free proxy scores on the standard seeded pairs
 #: plus the scorer-overhead pair the ISSUE 13 acceptance reads
@@ -799,6 +810,79 @@ def ledger_bench(requests: int = 24, gap_ms: float = 0.5,
         "p99_ledger_on_ms": p99_on,
         # p99_off must be truthy (the denominator); a collapsed-to-zero
         # p99_on still yields a computable -100% overhead
+        "p99_overhead_pct": (round(100.0 * (p99_on - p99_off) / p99_off, 2)
+                             if p99_off and p99_on is not None else None),
+    }
+
+
+# ---------------------------------------------------------- incidents
+
+
+def incident_bench(requests: int = 24, gap_ms: float = 0.5,
+                   max_batch: int = 4, timeout_ms: float = 5.0,
+                   bucket: tuple[int, int] = (32, 64),
+                   native_hw: tuple[int, int] = (30, 60),
+                   log_dir: str | None = None) -> dict:
+    """Incident-plane hot-path cost (obs/incident.py): the identical
+    seeded REAL-model workload with obs.incidents off vs ON with an
+    idle recorder — alert rules installed and evaluated on the stats
+    cadence, but no trigger ever fires. The recorder touches nothing
+    per-request (its only hot-path surface is the engine stats pass),
+    so the p99 delta is the plane's whole serving cost; the ISSUE 18
+    acceptance bounds it <= 1% of serve p99."""
+    import dataclasses as dc
+    import tempfile
+
+    from deepof_tpu.obs import incident as obs_incident
+
+    cfg0 = _bench_cfg(bucket, max_batch, timeout_ms, log_dir)
+    model_params = (_real_model_params(cfg0) if not log_dir else None)
+    run_dir = log_dir or tempfile.mkdtemp(prefix="incident_bench_")
+
+    rng = np.random.RandomState(0)
+    pairs = [(rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8),
+              rng.randint(0, 255, (*native_hw, 3), dtype=np.uint8))
+             for _ in range(max(int(requests), 1))]
+
+    def timed(on: bool):
+        cfg = cfg0.replace(
+            obs=dc.replace(cfg0.obs, incidents=on,
+                           # a registered, never-satisfiable rule rides
+                           # along so the installed recorder has
+                           # production shape (rules parse at install;
+                           # they evaluate on the heartbeat cadence,
+                           # never per request)
+                           alerts=(("serve_errors > 1e12",) if on
+                                   else ())),
+            train=dc.replace(cfg0.train, log_dir=run_dir))
+        with InferenceEngine(cfg, model_params=model_params) as eng:
+            eng.incidents = obs_incident.install(cfg, run_dir, "serve")
+            eng.warm()
+            # discarded pre-workload: steady-state hot paths only
+            # (same rationale as ledger_bench)
+            run_workload(eng, pairs[:max(int(max_batch), 2)], gap_ms)
+            wall, errors, results = run_workload(eng, pairs, gap_ms)
+            lats = [r["latency_s"] for r in results if r is not None]
+            stats = eng.stats()
+        rps = (len(pairs) - errors) / wall if wall > 0 else None
+        return rps, _percentile_ms(lats, 0.99), stats
+
+    rps_off, p99_off, _ = timed(False)
+    rps_on, p99_on, stats_on = timed(True)
+    return {
+        "mode": "incidents", "requests": len(pairs),
+        "max_batch": max_batch, "timeout_ms": timeout_ms,
+        "gap_ms": gap_ms, "bucket": list(bucket),
+        "alert_rules": stats_on.get("alert_rules"),
+        # no trigger fires on this healthy workload: stays 0, and the
+        # series in bench_trend.py pins the round's bundle count
+        "captured": stats_on.get("incident_captured"),
+        "rps_incidents_off": (round(rps_off, 2) if rps_off is not None
+                              else None),
+        "rps_incidents_on": (round(rps_on, 2) if rps_on is not None
+                             else None),
+        "p99_incidents_off_ms": p99_off,
+        "p99_incidents_on_ms": p99_on,
         "p99_overhead_pct": (round(100.0 * (p99_on - p99_off) / p99_off, 2)
                              if p99_off and p99_on is not None else None),
     }
@@ -1604,6 +1688,12 @@ def main(argv=None) -> int:
                          "recorded ledger.jsonl, and the ledger's "
                          "hot-path p99 overhead (on vs off — the ISSUE "
                          "15 bound is <= 2%%)")
+    ap.add_argument("--incidents", action="store_true",
+                    help="incident flight-recorder hot-path overhead "
+                         "(obs/incident.py): identical real-model "
+                         "workloads with obs.incidents off vs on with "
+                         "an idle recorder (no trigger fires — the "
+                         "ISSUE 18 bound is <= 1%% of serve p99)")
     args = ap.parse_args(argv)
 
     def hw(spec):
@@ -1654,6 +1744,12 @@ def main(argv=None) -> int:
                            log_dir=args.log_dir)
     elif args.ledger:
         res = ledger_bench(
+            requests=args.requests, gap_ms=args.gap_ms,
+            max_batch=args.max_batch, timeout_ms=args.timeout_ms,
+            bucket=hw(args.bucket), native_hw=hw(args.native),
+            log_dir=args.log_dir)
+    elif args.incidents:
+        res = incident_bench(
             requests=args.requests, gap_ms=args.gap_ms,
             max_batch=args.max_batch, timeout_ms=args.timeout_ms,
             bucket=hw(args.bucket), native_hw=hw(args.native),
