@@ -126,6 +126,25 @@ def _fleet_counters(rec: dict) -> dict:
             if k.startswith("fleet_") and v is not None}
 
 
+def _degrade_counters(rec: dict) -> dict:
+    """`degrade_*` counters from one record or heartbeat sample (the
+    brownout plane, serve/degrade.py: the live level, escalation/
+    recovery ledger, L3 age, and the tier/bucket downgrade + low-
+    priority shed counts the level drove). `tail` exits 10 when the
+    block shows sustained L3."""
+    return {k[len("degrade_"):]: v for k, v in rec.items()
+            if k.startswith("degrade_") and v is not None}
+
+
+def _deadline_counters(rec: dict) -> dict:
+    """`deadline_*` counters from one record or heartbeat sample (the
+    propagated-deadline plane: budgeted arrivals and where expired
+    budgets died — router admission, engine enqueue/flush, the server's
+    response wait)."""
+    return {k[len("deadline_"):]: v for k, v in rec.items()
+            if k.startswith("deadline_") and v is not None}
+
+
 def _elastic_counters(rec: dict) -> dict:
     """`elastic_*` counters from one record or heartbeat sample (the
     elastic-training block, train/elastic.py: generation, re-forms,
@@ -336,6 +355,12 @@ def summarize(records: list[dict]) -> dict:
         fleet = _fleet_counters(serves[-1])
         if fleet:
             out["fleet"] = fleet
+        degrade = _degrade_counters(serves[-1])
+        if degrade:
+            out["degrade"] = degrade
+        deadline = _deadline_counters(serves[-1])
+        if deadline:
+            out["deadline"] = deadline
         execs = _exec_counters(serves[-1])
         if execs:
             out["exec"] = execs
@@ -417,6 +442,8 @@ def _process_summary(d: str, now: float) -> dict:
             out["heartbeat_age_s"] = round(now - t, 1)
     for name, extract in (("serve", _serve_counters),
                           ("fleet", _fleet_counters),
+                          ("degrade", _degrade_counters),
+                          ("deadline", _deadline_counters),
                           ("elastic", _elastic_counters),
                           ("exec", _exec_counters)):
         block = extract(newest)
@@ -572,6 +599,15 @@ def tail_summary(log_dir: str, recent: int = 10,
         fleet_block = _fleet_counters(hb)
         if fleet_block:
             out["fleet"] = fleet_block
+        # the brownout/deadline planes (serve/degrade.py + the deadline
+        # gates): the live level, shed/downgrade ledger, and where
+        # expired budgets died — `tail` exits 10 on sustained L3
+        degrade = _degrade_counters(hb)
+        if degrade:
+            out["degrade"] = degrade
+        deadline = _deadline_counters(hb)
+        if deadline:
+            out["deadline"] = deadline
         # an elastic coordinator's heartbeat carries the live elastic_*
         # block (generation, re-forms, lost hosts, steps lost, per-host
         # states) — `tail` exits 5 when the run had to re-form
@@ -595,6 +631,14 @@ def tail_summary(log_dir: str, recent: int = 10,
             fleet_block = _fleet_counters(serves[-1])
             if fleet_block:
                 out["fleet"] = fleet_block
+        if "degrade" not in out:
+            degrade = _degrade_counters(serves[-1])
+            if degrade:
+                out["degrade"] = degrade
+        if "deadline" not in out:
+            deadline = _deadline_counters(serves[-1])
+            if deadline:
+                out["deadline"] = deadline
         if "exec" not in out:
             execs = _exec_counters(serves[-1])
             if execs:

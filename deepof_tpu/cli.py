@@ -393,7 +393,11 @@ def main(argv=None) -> int:
                      "the live ledger verdict records its own bundle) — "
                      "when the incident plane holds unacknowledged "
                      "CRITICAL flight-recorder bundles (obs.incidents; "
-                     "`incidents ack` clears it)")
+                     "`incidents ack` clears it), and 10 when the "
+                     "brownout controller held L3 (shedding low-priority"
+                     " work) past serve.degrade.l3_sustained_s — "
+                     "degradation was meant as a bridge to autoscaled "
+                     "capacity that never arrived (serve/degrade.py)")
     p_tail.add_argument("--log-dir", required=True)
     p_tail.add_argument("--recent", type=int, default=10,
                         help="train records in the throughput-trend window")
@@ -782,6 +786,16 @@ def main(argv=None) -> int:
                 for child in (summary.get("processes") or {}).values()]
             if any((q or {}).get("exhausted") for q in quality_blocks):
                 return 7
+            # rc 10 when the brownout controller (serve/degrade.py) has
+            # held L3 — shedding low-priority work — past its
+            # serve.degrade.l3_sustained_s budget: quality degradation
+            # was supposed to be a TRANSIENT bridge to autoscaled
+            # capacity, and a fleet parked at L3 means the capacity
+            # never arrived. Distinct from rc 6 (SLO budget) because a
+            # browned-out fleet can sit INSIDE its latency SLO exactly
+            # by refusing work.
+            if (summary.get("degrade") or {}).get("l3_sustained"):
+                return 10
             if not args.follow:
                 return 0
             import time as _time

@@ -487,6 +487,68 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class DegradeConfig:
+    """Brownout control plane (serve/degrade.py, DESIGN.md "Brownout"):
+    under overload the fleet walks declared quality-degradation levels
+    instead of shedding default-priority work —
+
+      L0 normal -> L1 downgrade the DEFAULT precision tier (requests
+      that name no `precision` serve at the cheapest configured tier)
+      -> L2 additionally route to the next-smaller shape bucket (flow
+      rescales to native pixels either way; only accuracy drops) ->
+      L3 additionally shed low-priority requests at router admission —
+
+    with a symmetric recovery ladder. Every (bucket, tier) pair is
+    already AOT-resolved through the artifact index, so walking levels
+    NEVER compiles anything (provable from the executable ledger).
+    The controller is the autoscaler's fast twin: it watches the same
+    live shed/occupancy/SLO-burn signals, but degrades within ~a
+    second where the autoscaler takes tens of seconds to add capacity
+    — degrade instantly, scale up slowly, recover when the new
+    capacity actually lands (occupancy falls back under the recovery
+    threshold)."""
+
+    # master switch: off keeps the serve/fleet path byte-identical to
+    # the pre-brownout stack (no controller thread, level pinned 0)
+    enabled: bool = False
+    # control-loop cadence — deliberately faster than
+    # fleet.autoscale_period_s: degradation is the instant response,
+    # capacity the slow one
+    period_s: float = 0.25
+    # escalate one level only after pressure (new shed/unavailable
+    # rejections, occupancy >= up_occupancy, or SLO burn >=
+    # up_slo_burn) persists this long
+    escalate_after_s: float = 0.5
+    # recover one level only after calm (zero new rejections AND
+    # occupancy <= down_occupancy AND burn < up_slo_burn) persists
+    # this long — much longer than the escalate window: degrading too
+    # late sheds work, recovering too early flaps quality
+    recover_after_s: float = 3.0
+    # no second escalation within this window of the previous one (a
+    # burst must not slam L0 -> L3 before L1's relief is even visible)
+    escalate_cooldown_s: float = 0.5
+    # no recovery within this window of ANY level transition
+    recover_cooldown_s: float = 2.0
+    # pool occupancy (router in-flight / (ready * fleet.max_in_flight))
+    # at or above which a tick counts as pressure — the queue-depth
+    # face of the verdict (router in-flight IS the fleet-wide queue)
+    up_occupancy: float = 0.85
+    # occupancy at or below which a tick can count as calm; the gap to
+    # up_occupancy is the hysteresis band where the level holds
+    down_occupancy: float = 0.5
+    # SLO error-budget burn fraction (obs.slo_latency_ms must be set
+    # for the signal to exist) at or above which a tick is pressure
+    up_slo_burn: float = 0.7
+    # highest level the controller may reach (3 = full ladder; 2 keeps
+    # low-priority traffic admitted however hot the fleet runs)
+    max_level: int = 3
+    # `tail` exits 10 (distinct from rc 3-9) when the fleet has sat at
+    # L3 continuously for at least this long — brownout as a steady
+    # state means capacity never arrived
+    l3_sustained_s: float = 30.0
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Streaming video sessions (serve/session.py, DESIGN.md "Streaming
     sessions"): a bounded per-session cache of the last frame's decoded +
@@ -620,6 +682,10 @@ class ServeConfig:
     # Self-healing replica fleet (serve/fleet.py); replicas=0 keeps the
     # single-process serve path.
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    # Brownout control plane (serve/degrade.py): deadline-aware
+    # admission + priority shedding + recompile-free quality
+    # degradation under overload.
+    degrade: DegradeConfig = field(default_factory=DegradeConfig)
 
 
 @dataclass(frozen=True)

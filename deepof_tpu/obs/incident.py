@@ -48,11 +48,15 @@ triggers from config without code:
     "[name:] [rate(]counter[)] OP value [warn|critical]"
 
 e.g. ``"err_burst: rate(serve_errors) > 5 critical"`` or
-``"serve_queue_depth >= 64"``. `rate()` is per-second between
+``"serve_queue_depth >= 64"`` or — the brownout plane's counters
+(serve/degrade.py) are registry-declared like any other —
+``"browned: degrade_level >= 2 warn"``. `rate()` is per-second between
 consecutive heartbeat samples; the counter must resolve in
 obs/registry.py (validated loudly at install time). A firing rule
 records an incident of kind ``alert_<name>`` — the dedup window is the
-re-fire policy while the condition holds.
+re-fire policy while the condition holds. (Entering brownout L3 also
+records a built-in critical ``brownout_l3`` bundle directly from the
+controller — no rule needed for the terminal level.)
 
 `obs.incidents=false` (the default) is a structural no-op: `install`
 returns None, no recorder exists, no `incident_*` key enters any

@@ -57,7 +57,7 @@ from dataclasses import dataclass
 #: must resolve in this registry.
 LINTED_PREFIXES: tuple[str, ...] = (
     "serve_", "fleet_", "elastic_", "data_", "fault_", "exec_",
-    "incident_", "alert_")
+    "incident_", "alert_", "degrade_", "deadline_")
 
 MERGE_KINDS: frozenset[str] = frozenset((
     "sum", "max", "gauge", "bool", "hist", "map", "state", "derived"))
@@ -72,7 +72,7 @@ class Key:
     kind: merge kind (see module docstring).
     owner: the subsystem that writes it — engine | session | quality |
         server | router | fleet | elastic | data | resilience | ckpt |
-        faults | train.
+        faults | train | degrade.
     prefix: True = family entry: every key starting with `name`
         resolves here (dynamically named counters — per-site fault
         counts). Exact entries always win over families.
@@ -193,6 +193,31 @@ _ENTRIES: list[Key] = [
            # any shed/breach landed — how often the pool scaled ahead
            # of the load instead of behind it
            "fleet_autoscale_slope_ticks"),
+    # -------------- deadline_* / degrade_* (the brownout plane, PR 19:
+    # serve/degrade.py + the deadline gates in engine/server/router).
+    # Names are DISJOINT by owner on purpose: the /metrics surface
+    # dict-merges router.stats() with the replica scrape, so a name two
+    # owners both wrote would silently clobber.
+    # engine-owned (per-replica, summed by the fleet scrape): budgeted
+    # arrivals, where expired budgets died, and requests actually served
+    # on a downgraded operating point
+    *_keys("engine", "sum",
+           "deadline_requests", "deadline_enqueue_expired",
+           "deadline_flush_expired", "deadline_wait_expired",
+           "degrade_tier_downgrades", "degrade_bucket_downgrades"),
+    # router-owned: admission/failover expiries + L3 low-priority sheds
+    *_keys("router", "sum",
+           "deadline_admission_expired", "degrade_shed_low"),
+    # controller-owned (serve/degrade.py stats block)
+    Key("degrade_enabled", "bool", "degrade"),
+    *_keys("degrade", "gauge", "degrade_level", "degrade_l3_age_s"),
+    Key("degrade_level_name", "state", "degrade"),
+    *_keys("degrade", "sum",
+           "degrade_transitions", "degrade_escalations",
+           "degrade_recoveries", "degrade_l3_entries"),
+    # sustained-L3 verdict: `tail`'s rc 10 (cli.py) reads this
+    Key("degrade_l3_sustained", "bool", "degrade"),
+    Key("degrade_last_reason", "state", "degrade"),
     # ------------------- exec_* (obs/ledger.py, the executable ledger:
     # compile/HLO/memory provenance per lowering — DESIGN.md
     # "Executable ledger"). Counters ride every stats surface that

@@ -58,6 +58,22 @@ def pick_bucket(native_hw: tuple[int, int],
     return buckets[-1]
 
 
+def next_smaller_bucket(bucket: tuple[int, int],
+                        buckets: tuple[tuple[int, int], ...],
+                        ) -> tuple[int, int]:
+    """One rung DOWN the ladder from `bucket` (brownout L2): the
+    next-smaller-area bucket, or `bucket` itself when it is already the
+    smallest (or off-ladder). Any bucket serves any native size — the
+    resize protocol rescales flow back to native pixel units — so the
+    downgrade only trades accuracy, never correctness, and the target is
+    always a warmed lattice entry (never a compile)."""
+    try:
+        idx = buckets.index(tuple(bucket))
+    except ValueError:
+        return tuple(bucket)
+    return buckets[idx - 1] if idx > 0 else tuple(bucket)
+
+
 def prepare_frame(img_raw: np.ndarray, bucket: tuple[int, int],
                   mean) -> np.ndarray:
     """ONE decoded BGR frame -> its preprocessed half-row (H, W, 3)

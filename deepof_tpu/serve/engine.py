@@ -89,7 +89,8 @@ from ..obs.export import (LatencyHistogram, percentile_ms, slo_state,
                           validate_slo)
 from ..obs.quality import (QualityScorer, make_score_fn, quality_avals,
                            score_pair_np)
-from .buckets import (flow_to_native, pick_bucket, prepare_frame,
+from .buckets import (flow_to_native, next_smaller_bucket, pick_bucket,
+                      prepare_frame,
                       prepare_pair, resolve_buckets)
 from .quant import dequantize_params, quantize_params, resolve_precisions
 from .session import SessionExpired, SessionStore
@@ -109,7 +110,9 @@ class ServeError(RuntimeError):
     raised — the whole flush fails), postprocess_failed (one request's
     resize/rescale raised), engine_closed, bad_request (server-side),
     session_expired (a streaming session was TTL-expired or LRU-evicted
-    — the client re-primes; serve/session.py)."""
+    — the client re-primes; serve/session.py), deadline_exceeded (the
+    caller's propagated X-Deadline-Ms budget expired before dispatch —
+    fail-fast instead of occupying a padded batch slot; HTTP 504)."""
 
     def __init__(self, code: str, message: str,
                  request_id: int | str | None = None):
@@ -127,11 +130,11 @@ class ServeError(RuntimeError):
 class _Request:
     __slots__ = ("x", "bucket", "tier", "native_hw", "future", "t_enq",
                  "rid", "session", "frame_index", "mode", "prior",
-                 "session_epoch", "score")
+                 "session_epoch", "score", "deadline")
 
     def __init__(self, x, bucket, tier, native_hw, future, t_enq, rid,
                  session=None, frame_index=None, mode="cold", prior=None,
-                 session_epoch=None):
+                 session_epoch=None, deadline=None):
         self.x = x
         self.bucket = bucket
         self.tier = tier
@@ -156,6 +159,11 @@ class _Request:
         # by the deterministic sampler; a sampled request's (input,
         # raw flow) pair is handed to the off-path scorer at resolve
         self.score = False
+        # absolute time.monotonic() the caller's budget expires (None =
+        # no deadline): checked at enqueue backpressure and again at
+        # flush, so a doomed request fails fast with deadline_exceeded
+        # instead of occupying a padded batch slot
+        self.deadline = deadline
 
     @property
     def key(self) -> tuple[tuple[int, int], str, str]:
@@ -531,6 +539,19 @@ class InferenceEngine:
         # server-side failures only (dispatch/postprocess/engine_closed):
         # the SLO error budget must not burn on a CALLER's bad input
         self._server_errors = 0
+        # deadline plane: requests arriving WITH a budget, and where
+        # expired ones died (enqueue backpressure / pre-dispatch flush /
+        # the server's response wait). Expiry is the CALLER's budget
+        # running out, not a server fault — like session_expired it
+        # counts serve_errors but never serve_server_errors.
+        self._deadline_requests = 0
+        self._deadline_enqueue_expired = 0
+        self._deadline_flush_expired = 0
+        self._deadline_wait_expired = 0
+        # brownout folding (serve/degrade.py): requests actually served
+        # on a cheaper operating point than they would have gotten at L0
+        self._degrade_tier_downgrades = 0
+        self._degrade_bucket_downgrades = 0
         self._latency_s: deque = deque(maxlen=_LATENCY_WINDOW)
         # fixed-bucket latency histogram (obs/export.py): the scrapeable
         # /metrics face of the latency story — fixed log-spaced buckets,
@@ -594,12 +615,24 @@ class InferenceEngine:
 
         return _imread_bgr(str(img))
 
-    def _resolve_tier(self, precision, rid) -> str:
+    def _resolve_tier(self, precision, rid, degrade_level: int = 0) -> str:
         """A request's tier: its explicit `precision` or the config's
         default; a tier this endpoint does not serve is a structured
         per-request error (no executable exists for it — admitting it
-        would compile on the hot path)."""
+        would compile on the hot path).
+
+        At brownout L1+ (serve/degrade.py) a request that named NO
+        precision serves at the cheapest configured tier instead of the
+        default — an explicit `precision` is always honored. Every tier
+        is a pre-warmed lattice entry, so the downgrade never compiles.
+        """
         if precision is None:
+            if degrade_level >= 1 and len(self.tiers) > 1:
+                tier = self.tiers[-1]  # config order: last = cheapest
+                if tier != self.default_tier:
+                    with self._stats_lock:
+                        self._degrade_tier_downgrades += 1
+                return tier
             return self.default_tier
         tier = str(precision)
         if tier not in self.tiers:
@@ -609,8 +642,19 @@ class InferenceEngine:
                 f"{list(self.tiers)}", rid)
         return tier
 
+    def _deadline_abs(self, deadline_s) -> float | None:
+        """Caller budget (seconds remaining) -> absolute monotonic
+        expiry; also ticks the deadline_requests ledger."""
+        if deadline_s is None:
+            return None
+        with self._stats_lock:
+            self._deadline_requests += 1
+        return time.monotonic() + max(float(deadline_s), 0.0)
+
     def submit(self, prev, nxt, precision: str | None = None,
-               request_id: int | str | None = None) -> Future:
+               request_id: int | str | None = None,
+               deadline_s: float | None = None,
+               degrade_level: int = 0) -> Future:
         """Enqueue one (prev, next) pair — paths or decoded BGR arrays.
 
         precision: serving tier ("f32" | "bf16" | "int8"); must be in
@@ -619,6 +663,13 @@ class InferenceEngine:
         stamped on this request's spans and echoed in the response, so
         obs/aggregate.py can chain the request's timeline across the
         router and this replica; None = a process-local sequence id.
+        deadline_s: the caller's remaining budget (X-Deadline-Ms / 1e3);
+        None = no deadline. An expired request fails fast with
+        `deadline_exceeded` at enqueue or flush instead of dispatching.
+        degrade_level: the live brownout level the router folded in
+        (X-Degrade-Level; serve/degrade.py) — L1+ downgrades the default
+        tier, L2+ routes one bucket down the ladder; both targets are
+        pre-warmed lattice entries, so degradation never compiles.
 
         Returns a Future resolving to {"flow": (H_native, W_native, 2)
         float32 in native pixel units, "bucket", "precision",
@@ -631,17 +682,25 @@ class InferenceEngine:
         with self._stats_lock:
             self._requests += 1
         try:
-            tier = self._resolve_tier(precision, rid)
+            tier = self._resolve_tier(precision, rid, degrade_level)
+            deadline = self._deadline_abs(deadline_s)
             with obs_trace.span("serve_enqueue", request_id=rid):
                 src = self._decode(prev)
                 tgt = self._decode(nxt)
                 native_hw = (int(src.shape[0]), int(src.shape[1]))
                 bucket = pick_bucket(native_hw, self.buckets)
+                if degrade_level >= 2:
+                    down = next_smaller_bucket(bucket, self.buckets)
+                    if down != bucket:
+                        bucket = down
+                        with self._stats_lock:
+                            self._degrade_bucket_downgrades += 1
                 x = prepare_pair(src, tgt, bucket, self.mean)
             with self._stats_lock:
                 self._requests_by_tier[tier] += 1
             self._enqueue(_Request(x, bucket, tier, native_hw, fut,
-                                   time.monotonic(), rid))
+                                   time.monotonic(), rid,
+                                   deadline=deadline))
         except ServeError as e:
             e.request_id = e.request_id or rid
             self._fail(fut, e)
@@ -653,21 +712,26 @@ class InferenceEngine:
     def submit_prepared(self, x: np.ndarray, bucket: tuple[int, int],
                         native_hw: tuple[int, int],
                         precision: str | None = None,
-                        request_id: int | str | None = None) -> Future:
+                        request_id: int | str | None = None,
+                        deadline_s: float | None = None) -> Future:
         """Enqueue an already-preprocessed row (offline mode: the
         data/pipeline.py worker pool runs prepare_pair concurrently and
-        feeds rows here in order)."""
+        feeds rows here in order). No brownout folding: the row is
+        already prepared at its bucket, and offline throughput work is
+        not latency-degradable."""
         rid = request_id if request_id is not None else next(self._rid)
         fut: Future = Future()
         with self._stats_lock:
             self._requests += 1
         try:
             tier = self._resolve_tier(precision, rid)
+            deadline = self._deadline_abs(deadline_s)
             with self._stats_lock:
                 self._requests_by_tier[tier] += 1
             self._enqueue(_Request(np.asarray(x, np.float32), tuple(bucket),
                                    tier, tuple(native_hw), fut,
-                                   time.monotonic(), rid))
+                                   time.monotonic(), rid,
+                                   deadline=deadline))
         except ServeError as e:
             e.request_id = e.request_id or rid
             self._fail(fut, e)
@@ -675,7 +739,9 @@ class InferenceEngine:
 
     def submit_next(self, session: str, frame,
                     precision: str | None = None,
-                    request_id: int | str | None = None) -> Future:
+                    request_id: int | str | None = None,
+                    deadline_s: float | None = None,
+                    degrade_level: int = 0) -> Future:
         """Advance a streaming session by ONE frame (serve/session.py).
 
         The first frame of a session primes it: the future resolves
@@ -691,6 +757,12 @@ class InferenceEngine:
         `resumed`); a mid-session resolution change re-primes in place
         (a fresh `primed` reply, counted as `rebucketed`). A decode
         failure fails this frame only and does NOT advance the session.
+
+        Brownout folding is tier-only here: L1+ downgrades the default
+        precision, but L2's bucket downgrade is deliberately NOT applied
+        to streaming steps — a bucket change re-primes the session
+        (advance()'s rebucket path), dropping the cached frame and warm
+        prior, which would cost more than the smaller bucket saves.
         """
         rid = request_id if request_id is not None else next(self._rid)
         fut: Future = Future()
@@ -700,7 +772,8 @@ class InferenceEngine:
         kind_hint = "session_step" if self.sessions.contains(session) \
             else "session_prime"
         try:
-            tier = self._resolve_tier(precision, rid)
+            tier = self._resolve_tier(precision, rid, degrade_level)
+            deadline = self._deadline_abs(deadline_s)
             with obs_trace.span(kind_hint, session=str(session),
                                 request_id=rid) as span:
                 img = self._decode(frame)
@@ -753,7 +826,8 @@ class InferenceEngine:
                                    frame_index=s.frames - 1,
                                    mode=mode,
                                    prior=prior if mode == "warm" else None,
-                                   session_epoch=epoch))
+                                   session_epoch=epoch,
+                                   deadline=deadline))
         except ServeError as e:
             e.request_id = e.request_id or rid
             if not counted:  # failed frames stay ledgered, exactly once
@@ -783,15 +857,27 @@ class InferenceEngine:
                 self._quality_index += 1
         try:
             # bounded put = backpressure, but polled: a submitter blocked
-            # on a full queue must observe close() instead of completing
-            # its put into a dead queue (its future would never resolve —
-            # close() drains only after _submitting hits 0)
+            # on a full queue must observe close() — and its own
+            # deadline — instead of completing its put into a dead queue
+            # (its future would never resolve — close() drains only
+            # after _submitting hits 0). A doomed request releasing its
+            # backpressure slot here is load the queue never carries.
             while True:
                 if self._closed:
                     raise ServeError("engine_closed", "engine is shut down",
                                      req.rid)
+                if req.deadline is not None:
+                    rem = req.deadline - time.monotonic()
+                    if rem <= 0:
+                        with self._stats_lock:
+                            self._deadline_enqueue_expired += 1
+                        raise ServeError(
+                            "deadline_exceeded",
+                            "deadline expired while queueing", req.rid)
+                else:
+                    rem = 0.1
                 try:
-                    self._q.put(req, timeout=0.1)
+                    self._q.put(req, timeout=min(0.1, max(rem, 0.001)))
                     break
                 except queue.Full:
                     continue
@@ -807,11 +893,22 @@ class InferenceEngine:
             self._errors += 1
             # session_expired is protocol, not failure: the client let
             # its session idle past the TTL (or lost an LRU race) and
-            # re-primes — it must not burn the operator's SLO budget
+            # re-primes — it must not burn the operator's SLO budget.
+            # deadline_exceeded likewise: the CALLER's budget ran out,
+            # not the server — overload shows up in the deadline_* and
+            # degrade_* ledgers instead.
             if err.code not in ("bad_input", "bad_request",
-                                "session_expired"):
+                                "session_expired", "deadline_exceeded"):
                 self._server_errors += 1  # burns the SLO error budget
         fut.set_exception(err)
+
+    def note_wait_expired(self) -> None:
+        """The SERVER's deadline ledger hook: its response wait hit the
+        caller's budget (min(request_timeout_s, deadline)) before this
+        engine resolved the future. Counted here so every stage of the
+        deadline story rides one stats surface."""
+        with self._stats_lock:
+            self._deadline_wait_expired += 1
 
     # ----------------------------------------------------------- batcher
     def _run(self) -> None:
@@ -870,6 +967,22 @@ class InferenceEngine:
                     req.rid))
 
     def _flush(self, batch: list[_Request]) -> None:
+        # last pre-dispatch deadline gate: a request whose budget
+        # expired while batching fails fast HERE — its padded batch slot
+        # (and the postprocess work) would be wasted on a reply the
+        # caller already abandoned
+        expired = [r for r in batch if r.deadline is not None
+                   and r.deadline <= time.monotonic()]
+        if expired:
+            with self._stats_lock:
+                self._deadline_flush_expired += len(expired)
+            for r in expired:
+                self._fail(r.future, ServeError(
+                    "deadline_exceeded", "deadline expired before dispatch",
+                    r.rid))
+            batch = [r for r in batch if r not in expired]
+            if not batch:
+                return
         bucket, tier, mode = batch[0].key
         n = len(batch)
         tag = f"{bucket[0]}x{bucket[1]}/{tier}/{mode}"
@@ -1396,6 +1509,16 @@ class InferenceEngine:
                 "serve_max_batch": self.max_batch,
                 "serve_buckets": len(self.buckets),
                 "serve_tiers": len(self.tiers),
+                # deadline plane: budgeted arrivals + where expired ones
+                # died (enqueue / flush / the server's response wait)
+                "deadline_requests": self._deadline_requests,
+                "deadline_enqueue_expired": self._deadline_enqueue_expired,
+                "deadline_flush_expired": self._deadline_flush_expired,
+                "deadline_wait_expired": self._deadline_wait_expired,
+                # brownout folding: requests actually served cheaper
+                # than their L0 operating point (serve/degrade.py)
+                "degrade_tier_downgrades": self._degrade_tier_downgrades,
+                "degrade_bucket_downgrades": self._degrade_bucket_downgrades,
             }
         if lat:
             out["serve_latency_p50_ms"] = round(

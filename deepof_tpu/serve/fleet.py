@@ -781,6 +781,7 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
     httpd = None
     hb = None
     scaler = None
+    degr = None
     # one teardown path for EVERY exit — replicas are detached
     # (start_new_session), so any escape without fleet.close() would
     # orphan serving processes: a partway-failed start() (EMFILE on
@@ -811,6 +812,19 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
             # the heartbeat sample and the shutdown record all see them
             router.autoscale_stats = scaler.stats
             scaler.start()
+
+        if cfg.serve.degrade.enabled:
+            from .degrade import DegradeController
+
+            # the brownout plane (serve/degrade.py): degrades QUALITY
+            # within ~a second while the autoscaler (above) adds
+            # capacity over minutes — the two watch the same signals,
+            # so the level walks back down when the capacity lands
+            degr = DegradeController(cfg, fleet, router)
+            degr.incidents = fleet.incidents  # L3 entry -> critical bundle
+            router.degrade_stats = degr.stats
+            router.degrade_level = degr.level
+            degr.start()
 
         hb_ref: dict = {}
 
@@ -859,6 +873,8 @@ def _run_fleet(cfg: ExperimentConfig, replicas: int | None) -> int:
             pass
         return 0
     finally:
+        if degr is not None:
+            degr.close()  # no level transitions during teardown
         if scaler is not None:
             scaler.close()  # no scale events during teardown
         if router is not None:

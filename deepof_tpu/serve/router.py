@@ -73,7 +73,7 @@ from ..obs import trace as obs_trace
 from ..obs.export import (LatencyHistogram, render_prometheus, slo_state,
                           validate_slo)
 from ..obs.registry import merge_stats_blocks
-from .buckets import pick_bucket, resolve_buckets
+from .buckets import next_smaller_bucket, pick_bucket, resolve_buckets
 from .quant import resolve_precisions
 
 #: load-trend window: how many FULL seconds of per-second completion
@@ -165,6 +165,12 @@ class Router:
         # so scale counters ride /healthz, /metrics and the heartbeat
         # exactly like every other fleet_* counter
         self.autoscale_stats: Callable[[], dict] | None = None
+        # brownout plane (serve/degrade.py; run_fleet wires both when
+        # serve.degrade.enabled): degrade_level is the live level the
+        # router folds into every routing decision, degrade_stats the
+        # controller's degrade_* block merged into stats()
+        self.degrade_stats: Callable[[], dict] | None = None
+        self.degrade_level: Callable[[], int] | None = None
         self._lock = threading.Lock()
         self._in_flight: dict[int, int] = defaultdict(int)
         self._routed: dict[int, int] = defaultdict(int)
@@ -215,6 +221,13 @@ class Router:
         self._sessions_lost = 0    # pinned replica gone -> 410 session_lost
         self._session_evicted = 0  # sticky-map LRU drops
         self._session_expired = 0  # sticky-map TTL drops
+        # deadline/brownout admission ledger: budgets that expired
+        # before any replica was tried (the caller's fault, counted
+        # apart from fleet_server_errors), and low-priority requests
+        # shed at L3 (deliberate brownout refusals, counted apart from
+        # fleet_shed so saturation sheds stay a clean overload signal)
+        self._deadline_admission_expired = 0
+        self._degrade_shed_low = 0
 
     # ---------------------------------------------------------- routing
     def _preferred(self, key) -> int:
@@ -273,7 +286,8 @@ class Router:
                 self._in_flight[idx] -= 1
 
     def _proxy(self, replica, path: str, body: bytes, ctype: str,
-               request_id: str | None = None, method: str = "POST"):
+               request_id: str | None = None, method: str = "POST",
+               deadline: float | None = None, level: int = 0):
         conn = http.client.HTTPConnection(self.fleet.host, replica.port,
                                           timeout=self.timeout_s)
         headers = {"Content-Type": ctype or "application/json"}
@@ -281,6 +295,17 @@ class Router:
             # the replica stamps this id on its engine spans: the merged
             # fleet trace chains router -> replica per request
             headers["X-Request-Id"] = request_id
+        if deadline is not None:
+            # propagate the REMAINING budget (not the original): queue
+            # and failover time already spent at the front is gone —
+            # the replica's enqueue/flush/wait gates see the truth
+            rem_ms = max((deadline - time.monotonic()) * 1e3, 0.0)
+            headers["X-Deadline-Ms"] = f"{rem_ms:.3f}"
+        if level > 0:
+            # the live brownout level rides per-request: the replica
+            # folds it at submit (tier/bucket downgrade), keeping every
+            # degradation decision on the pre-warmed lattice
+            headers["X-Degrade-Level"] = str(int(level))
         try:
             conn.request(method, path, body, headers)
             resp = conn.getresponse()
@@ -340,12 +365,16 @@ class Router:
             return None
         return req if isinstance(req, dict) else None
 
-    def _key_from(self, req: dict | None, image_field: str = "prev"):
+    def _key_from(self, req: dict | None, image_field: str = "prev",
+                  level: int = 0):
         """Best-effort affinity (bucket, tier) from a parsed body:
         header-probe the image's dimensions without decoding it, and
         read the declared `precision` (an unknown tier routes as the
         default — the replica produces the structured 400, not the
-        front)."""
+        front). The live brownout level folds in the SAME downgrades the
+        replica engine will apply (L1+: default tier -> cheapest; L2+:
+        one bucket down the ladder), so affinity keeps pointing at the
+        replica that holds the degraded executable hot."""
         if req is None:
             return None
         bucket = None
@@ -354,6 +383,8 @@ class Router:
             p = req.get("precision")
             if p in self.tiers:
                 tier = p
+            elif level >= 1 and len(self.tiers) > 1:
+                tier = self.tiers[-1]  # mirror engine._resolve_tier
             img_b64 = req.get(image_field, "")
             if img_b64:
                 # the first ~KB of image bytes holds every header we
@@ -363,9 +394,53 @@ class Router:
                 hw = probe_image_hw(raw)
                 if hw:
                     bucket = pick_bucket(hw, self.buckets)
+                    if level >= 2:
+                        bucket = next_smaller_bucket(bucket, self.buckets)
         except Exception:  # noqa: BLE001 - affinity is best-effort
             return None
         return (bucket, tier) if bucket is not None else None
+
+    def _level(self) -> int:
+        """The live brownout level (0 with no controller wired)."""
+        hook = self.degrade_level
+        if hook is None:
+            return 0
+        try:
+            return max(int(hook()), 0)
+        except Exception:  # noqa: BLE001 - degrade never kills routing
+            return 0
+
+    @staticmethod
+    def _request_meta(req: dict | None, headers,
+                      t0: float) -> tuple[float | None, str]:
+        """(absolute monotonic deadline | None, priority) from the
+        request's headers/body: `X-Deadline-Ms` (header wins) or body
+        `deadline_ms` = the caller's REMAINING budget in ms;
+        `X-Priority` or body `priority` in {default, low}. Malformed
+        values raise ValueError — admission answers 400, not "ignored".
+        """
+        raw = None
+        if headers is not None:
+            raw = headers.get("X-Deadline-Ms")
+        if raw is None and req is not None:
+            raw = req.get("deadline_ms")
+        deadline = None
+        if raw is not None:
+            try:
+                deadline = t0 + float(raw) / 1e3
+            except (TypeError, ValueError):
+                raise ValueError(f"deadline_ms must be a number, "
+                                 f"got {raw!r}")
+        prio = None
+        if headers is not None:
+            prio = headers.get("X-Priority")
+        if prio is None and req is not None:
+            prio = req.get("priority")
+        if prio is None:
+            prio = "default"
+        if prio not in ("default", "low"):
+            raise ValueError(f"priority must be default|low, got {prio!r}")
+        return deadline, prio
 
     def route_key(self, body: bytes):
         """Best-effort affinity (bucket, tier) for a /v1/flow body (the
@@ -373,8 +448,8 @@ class Router:
         directly)."""
         return self._key_from(self._body_json(body))
 
-    def handle_flow(self, path: str, body: bytes,
-                    ctype: str) -> tuple[int, bytes, str]:
+    def handle_flow(self, path: str, body: bytes, ctype: str,
+                    headers=None) -> tuple[int, bytes, str]:
         """Route one POST /v1/flow or /v1/flow/stream: returns (status,
         payload, ctype) — always; a request admitted here cannot be
         silently dropped. Stream frames with a pinned session route
@@ -382,19 +457,56 @@ class Router:
         the affinity ladder with failover replay.
         Every admitted request gets an X-Request-Id (router pid + seq)
         stamped downstream, a `route` span on the router's tracer, and
-        a front-door latency observation on success."""
+        a front-door latency observation on success. `headers` (the
+        inbound request headers, when the frontend passes them) carries
+        the deadline/priority plane: X-Deadline-Ms and X-Priority."""
         rid = f"r{os.getpid():x}-{next(self._rid_seq)}"
         t0 = time.monotonic()
         with self._lock:
             self._requests += 1
         with obs_trace.span("route", request_id=rid) as span:
             status, payload, rtype = self._route(path, body, ctype, rid,
-                                                 t0, span)
+                                                 t0, span, headers)
         return status, payload, rtype
 
     def _route(self, path: str, body: bytes, ctype: str, rid: str,
-               t0: float, span) -> tuple[int, bytes, str]:
+               t0: float, span, headers=None) -> tuple[int, bytes, str]:
         req = self._body_json(body)
+        try:
+            deadline, priority = self._request_meta(req, headers, t0)
+        except ValueError as e:
+            with self._lock:
+                self._errors += 1  # client error: no SLO budget burned
+            span.set(outcome="bad_request")
+            return (400, json.dumps({"error": "bad_request",
+                                     "message": str(e),
+                                     "request_id": rid}).encode(),
+                    "application/json")
+        # admission gates, BEFORE any replica slot is considered: an
+        # already-expired budget fails fast (the caller abandoned the
+        # reply), and at L3 the brownout controller sheds low-priority
+        # work so remaining capacity serves the default class
+        if deadline is not None and deadline <= time.monotonic():
+            with self._lock:
+                self._errors += 1
+                self._deadline_admission_expired += 1
+            span.set(outcome="deadline_exceeded")
+            return (504, json.dumps({
+                "error": "deadline_exceeded",
+                "message": "deadline expired at admission",
+                "request_id": rid}).encode(), "application/json")
+        level = self._level()
+        if level >= 3 and priority == "low":
+            with self._lock:
+                self._errors += 1
+                self._server_errors += 1
+                self._degrade_shed_low += 1
+            span.set(outcome="shed_low_priority")
+            return (503, json.dumps({
+                "error": "shed_low_priority",
+                "message": "brownout L3: low-priority requests are shed "
+                           "— retry later or raise priority",
+                "request_id": rid}).encode(), "application/json")
         sid = None
         if self._is_stream(path) and req is not None:
             s = req.get("session")
@@ -406,11 +518,24 @@ class Router:
                     # one replica: route there or demote to session_lost
                     # — never replay on a sibling (it has no state)
                     return self._route_pinned(path, body, ctype, rid, t0,
-                                              span, sid, pinned)
-        key = self._key_from(req, "frame" if sid is not None else "prev")
+                                              span, sid, pinned,
+                                              deadline, level)
+        key = self._key_from(req, "frame" if sid is not None else "prev",
+                             level=level)
         tried: set[int] = set()
         last_error = None
         for attempt in range(self.retries + 1):
+            if deadline is not None and deadline <= time.monotonic():
+                # the budget died between attempts: stop burning
+                # sibling replicas on a reply nobody is waiting for
+                with self._lock:
+                    self._errors += 1
+                    self._deadline_admission_expired += 1
+                span.set(outcome="deadline_exceeded", attempts=attempt)
+                return (504, json.dumps({
+                    "error": "deadline_exceeded",
+                    "message": "deadline expired during failover",
+                    "request_id": rid}).encode(), "application/json")
             replica, reason = self._acquire(key, tried)
             if replica is None:
                 if reason == "exhausted":
@@ -431,7 +556,9 @@ class Router:
                         "application/json")
             try:
                 status, payload, rtype = self._proxy(replica, path, body,
-                                                     ctype, request_id=rid)
+                                                     ctype, request_id=rid,
+                                                     deadline=deadline,
+                                                     level=level)
             except Exception as e:  # noqa: BLE001 - transport = failover
                 self._release(replica.idx)
                 last_error = f"{type(e).__name__}: {e}"
@@ -442,6 +569,15 @@ class Router:
                 self.fleet.note_failure(replica.idx)
                 continue
             self._release(replica.idx)
+            if (status == 504 and b"deadline_exceeded" in payload):
+                # the CALLER's budget died on the replica — relaying is
+                # correct and replaying on a sibling would waste its
+                # slot on the same expired budget; not a replica fault
+                with self._lock:
+                    self._errors += 1
+                span.set(replica=replica.idx, status=status,
+                         outcome="deadline_exceeded", attempts=attempt + 1)
+                return status, payload, rtype
             if status >= 500:  # replica-level failure: replay on a sibling
                 last_error = payload.decode("utf-8", "replace")[:200]
                 tried.add(replica.idx)
@@ -489,12 +625,17 @@ class Router:
         }).encode(), "application/json")
 
     def _route_pinned(self, path: str, body: bytes, ctype: str, rid: str,
-                      t0: float, span, sid: str,
-                      pinned: int) -> tuple[int, bytes, str]:
+                      t0: float, span, sid: str, pinned: int,
+                      deadline: float | None = None,
+                      level: int = 0) -> tuple[int, bytes, str]:
         """One attempt against a session's pinned replica — no failover
         (a sibling has no cached frame; replaying there would silently
         re-prime mid-stream). A gone/failing pinned replica demotes to a
-        structured 410 `session_lost` the client re-primes from."""
+        structured 410 `session_lost` the client re-primes from.
+        The deadline and brownout level ride through like the unpinned
+        path (the replica folds L1's tier downgrade; L2's bucket
+        downgrade deliberately does not apply to streaming steps —
+        engine.submit_next documents why)."""
         replica = next((r for r in self.fleet.ready_replicas()
                         if r.idx == pinned), None)
         if replica is None:
@@ -517,13 +658,23 @@ class Router:
             self._routed[replica.idx] += 1
         try:
             status, payload, rtype = self._proxy(replica, path, body,
-                                                 ctype, request_id=rid)
+                                                 ctype, request_id=rid,
+                                                 deadline=deadline,
+                                                 level=level)
         except Exception as e:  # noqa: BLE001 - transport = session lost
             self._release(replica.idx)
             self.fleet.note_failure(replica.idx)
             return self._session_lost_reply(sid, span,
                                             f"{type(e).__name__}: {e}")
         self._release(replica.idx)
+        if status == 504 and b"deadline_exceeded" in payload:
+            # the caller's budget, not the replica's health — relay;
+            # the session (and its pin) stays alive for the next frame
+            with self._lock:
+                self._errors += 1
+            span.set(replica=replica.idx, status=status, session=sid,
+                     outcome="deadline_exceeded", attempts=1)
+            return status, payload, rtype
         if status >= 500:
             self.fleet.note_failure(replica.idx)
             return self._session_lost_reply(
@@ -692,6 +843,12 @@ class Router:
                 "fleet_session_lost": self._sessions_lost,
                 "fleet_session_evicted": self._session_evicted,
                 "fleet_session_expired": self._session_expired,
+                # deadline/brownout admission ledger (router-owned; the
+                # engines' deadline_*/degrade_* stage counters arrive
+                # via the replica scrape, names disjoint by design)
+                "deadline_admission_expired":
+                    self._deadline_admission_expired,
+                "degrade_shed_low": self._degrade_shed_low,
             }
             requests, failures = self._requests, self._server_errors
         out["fleet_latency_hist"] = hist
@@ -699,6 +856,12 @@ class Router:
         if scaler is not None:
             try:
                 out.update(scaler())
+            except Exception:  # noqa: BLE001 - obs never kills routing
+                pass
+        degr = self.degrade_stats
+        if degr is not None:
+            try:
+                out.update(degr())
             except Exception:  # noqa: BLE001 - obs never kills routing
                 pass
         if float(self.cfg.obs.slo_latency_ms) > 0:
@@ -757,7 +920,7 @@ class Router:
                     except Exception:  # noqa: BLE001 - sick replica: skip
                         results.append(None)
         blocks = [{k: v for k, v in stats.items()
-                   if k.startswith("serve_")}
+                   if k.startswith(("serve_", "deadline_", "degrade_"))}
                   for stats in results if stats is not None]
         out = merge_stats_blocks(blocks)
         out["serve_replicas_scraped"] = len(blocks)
@@ -843,7 +1006,8 @@ def build_router_server(cfg: ExperimentConfig, router: Router):
                                        "message": f"{type(e).__name__}: {e}"})
                 return
             status, payload, ctype = router.handle_flow(
-                self.path, body, self.headers.get("Content-Type", ""))
+                self.path, body, self.headers.get("Content-Type", ""),
+                headers=self.headers)
             self._reply(status, payload, ctype)
 
         def do_DELETE(self):  # noqa: N802

@@ -220,6 +220,29 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                                      f"format must be json|flo|png, "
                                      f"got {fmt!r}")
                 precision = req.get("precision")  # None = default tier
+                # the propagated deadline (router admission re-stamps
+                # the REMAINING budget in X-Deadline-Ms; direct callers
+                # may also set body "deadline_ms"): strict parse — a
+                # malformed budget is a client error, not "no deadline"
+                raw_deadline = self.headers.get("X-Deadline-Ms",
+                                                req.get("deadline_ms"))
+                deadline_s = None
+                if raw_deadline is not None:
+                    try:
+                        deadline_s = float(raw_deadline) / 1e3
+                    except (TypeError, ValueError):
+                        raise ServeError(
+                            "bad_request",
+                            f"deadline_ms must be a number, "
+                            f"got {raw_deadline!r}")
+                # the live brownout level the router folded in
+                # (serve/degrade.py); lenient — a replica hit directly
+                # simply serves at L0
+                try:
+                    degrade_level = int(
+                        self.headers.get("X-Degrade-Level", 0))
+                except (TypeError, ValueError):
+                    degrade_level = 0
                 if stream:
                     sid = req.get("session")
                     if not isinstance(sid, str) or not sid:
@@ -247,18 +270,42 @@ def build_server(cfg: ExperimentConfig, engine: InferenceEngine):
                 return
             if stream:
                 fut = engine.submit_next(sid, frame, precision=precision,
-                                         request_id=request_id)
+                                         request_id=request_id,
+                                         deadline_s=deadline_s,
+                                         degrade_level=degrade_level)
             else:
                 fut = engine.submit(prev, nxt, precision=precision,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    deadline_s=deadline_s,
+                                    degrade_level=degrade_level)
+            # wait on min(blanket timeout, the caller's own budget): a
+            # doomed request must release this handler thread (and the
+            # caller) when ITS deadline lapses, not at the blanket cap
+            wait_s = timeout_s
+            if deadline_s is not None:
+                wait_s = min(timeout_s, max(deadline_s, 0.0))
             try:
-                res = fut.result(timeout=timeout_s)
+                res = fut.result(timeout=wait_s)
             except ServeError as e:
                 status = (400 if e.code in ("bad_input", "bad_request")
-                          else 410 if e.code == "session_expired" else 500)
+                          else 410 if e.code == "session_expired"
+                          else 504 if e.code == "deadline_exceeded"
+                          else 500)
                 self._reply_json(status, e.payload())
                 return
             except _FuturesTimeout:
+                if wait_s < timeout_s:
+                    # the caller's budget lapsed first — same structured
+                    # verdict the engine's own gates emit, ledgered so
+                    # the deadline story is complete across stages
+                    engine.note_wait_expired()
+                    self._reply_json(504, {
+                        "error": "deadline_exceeded",
+                        "message": f"deadline lapsed after {wait_s}s "
+                                   f"waiting for dispatch",
+                        **({"request_id": request_id}
+                           if request_id is not None else {})})
+                    return
                 self._reply_json(504, {"error": "timeout",
                                        "message": f"no response within "
                                                   f"{timeout_s}s"})

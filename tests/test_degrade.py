@@ -1,0 +1,541 @@
+"""Brownout control-plane tests (DESIGN.md "Brownout").
+
+Unit tier (no threads, no sleeps beyond the batcher's own): the
+DegradeController decision core driven with fabricated clocks/signals
+(the `Autoscaler.evaluate` idiom from test_supervise.py) — escalation,
+symmetric recovery, hysteresis-band streak resets, cooldowns, level
+bounds, and no flapping under an oscillating load; the engine's
+deadline gates at every stage (enqueue backpressure, pre-dispatch
+flush) and its L1/L2 operating-point folding; the router's admission
+deadline gate, malformed-header rejection, and the L3 low-priority
+shed ordering against stub replicas; and `tail` rc 10 on sustained L3.
+
+Chaos tier (subprocess replicas, fake timed executor): the ISSUE 19
+acceptance drill — the identical mixed-priority overload against two
+live 2-replica fleets, brownout off vs on; the ON leg must shed ZERO
+default-priority requests while the OFF leg sheds >= 1, with the
+ladder walk visible in the degrade_* counters.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from deepof_tpu.core.config import get_config
+from deepof_tpu.serve.buckets import next_smaller_bucket
+from deepof_tpu.serve.degrade import LEVELS, DegradeController
+from deepof_tpu.serve.engine import InferenceEngine, ServeError
+
+# ----------------------------------------------------------- helpers
+
+
+def _cfg(max_batch=4, timeout_ms=400.0, buckets=(), image_size=(32, 64),
+         log_dir="/tmp/deepof_degrade_test", **serve_kw):
+    cfg = get_config("flyingchairs")
+    return cfg.replace(
+        model="flownet_s", width_mult=0.25,
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=image_size, gt_size=image_size),
+        serve=dataclasses.replace(cfg.serve, max_batch=max_batch,
+                                  batch_timeout_ms=timeout_ms,
+                                  buckets=buckets, **serve_kw),
+        train=dataclasses.replace(cfg.train, eval_amplifier=1.0,
+                                  eval_clip=(-1e6, 1e6), log_dir=log_dir))
+
+
+class _FakeForward:
+    """Deterministic timed executor (test_serve.py's): per-dispatch
+    sleep, flow = channel difference."""
+
+    def __init__(self, exec_s=0.0):
+        self.exec_s = exec_s
+        self.dispatches = 0
+        self.lock = threading.Lock()
+
+    def __call__(self, bucket, x):
+        with self.lock:
+            self.dispatches += 1
+        if self.exec_s > 0:
+            time.sleep(self.exec_s)
+        return np.stack([x[..., 0] - x[..., 3], x[..., 1] - x[..., 4]],
+                        axis=-1).astype(np.float32)
+
+
+def _img(rng, hw=(48, 96)):
+    return rng.randint(1, 255, (*hw, 3), dtype=np.uint8)
+
+
+def _ctrl(**degrade_kw):
+    """A DegradeController with no live fleet/router: `evaluate` is a
+    pure function of (clock, signals, accumulated streak state)."""
+    defaults = dict(enabled=True, period_s=0.25, escalate_after_s=2.0,
+                    recover_after_s=10.0, escalate_cooldown_s=5.0,
+                    recover_cooldown_s=5.0, up_occupancy=0.85,
+                    down_occupancy=0.5, up_slo_burn=0.7, max_level=3,
+                    l3_sustained_s=30.0)
+    defaults.update(degrade_kw)
+    cfg = get_config("flyingchairs")
+    cfg = cfg.replace(serve=dataclasses.replace(
+        cfg.serve, degrade=dataclasses.replace(cfg.serve.degrade,
+                                               **defaults)))
+    return DegradeController(cfg, fleet=None, router=None)
+
+
+def _sig(**kw):
+    base = dict(ready=2, bad_total=0, occupancy=0.6, slo_burn=0.0)
+    base.update(kw)
+    return base
+
+
+# ------------------------------------------------- decision core (pure)
+
+
+def test_degrade_shed_pressure_sustained_escalates():
+    c = _ctrl()
+    # new refused work each tick: pressure from t=0, sustained past the
+    # 2 s window -> ONE escalation, reason shed
+    assert c.evaluate(0.0, _sig(bad_total=5)) == (None, "holding")
+    assert c.evaluate(1.0, _sig(bad_total=9))[0] is None
+    assert c.evaluate(2.5, _sig(bad_total=14)) == ("escalate", "shed")
+
+
+def test_degrade_hysteresis_band_resets_streaks():
+    c = _ctrl()
+    c.evaluate(0.0, _sig(occupancy=0.9))
+    # one mid-band tick (between down 0.5 and up 0.85) resets the
+    # pressure streak: the next decision re-earns its full window
+    c.evaluate(1.5, _sig(occupancy=0.6))
+    assert c.evaluate(3.0, _sig(occupancy=0.9))[0] is None
+    assert c.evaluate(5.5, _sig(occupancy=0.9)) == ("escalate", "occupancy")
+
+
+def test_degrade_no_flapping_under_oscillating_load():
+    """A load oscillating faster than either window never transitions:
+    each pressure tick kills the calm streak and vice versa — the
+    controller holds instead of flapping the fleet's operating point."""
+    c = _ctrl(escalate_after_s=2.0, recover_after_s=2.0)
+    c._level = 1
+    t = 0.0
+    for _ in range(40):
+        assert c.evaluate(t, _sig(occupancy=0.95))[0] is None
+        t += 1.0
+        assert c.evaluate(t, _sig(occupancy=0.2))[0] is None
+        t += 1.0
+    assert c.level() == 1
+
+
+def test_degrade_escalate_cooldown_and_max_level():
+    c = _ctrl()
+    c._last_escalate_m = 2.0
+    c.evaluate(3.0, _sig(occupancy=1.0))
+    # window met at 5.5 but only 3.5 s since the last escalation
+    assert c.evaluate(5.5, _sig(occupancy=1.0)) == (None,
+                                                    "escalate cooldown")
+    assert c.evaluate(7.5, _sig(occupancy=1.0))[0] == "escalate"
+    # at the ladder's top: pressure is reported, never acted on
+    c2 = _ctrl()
+    c2._level = 3
+    c2.evaluate(0.0, _sig(occupancy=1.0))
+    action, reason = c2.evaluate(2.5, _sig(occupancy=1.0))
+    assert action is None and "max_level" in reason
+
+
+def test_degrade_recovery_symmetric_with_cooldown_and_floor():
+    c = _ctrl()
+    c._level = 2
+    c.evaluate(0.0, _sig(occupancy=0.3))
+    assert c.evaluate(5.0, _sig(occupancy=0.3))[0] is None
+    assert c.evaluate(10.5, _sig(occupancy=0.3)) == ("recover",
+                                                     "sustained calm")
+    # a fresh transition blocks the next recovery for recover_cooldown_s
+    c2 = _ctrl()
+    c2._level = 2
+    c2._last_event_m = 9.0
+    c2.evaluate(2.0, _sig(occupancy=0.3))
+    assert c2.evaluate(12.5, _sig(occupancy=0.3)) == (None,
+                                                      "recover cooldown")
+    # at L0 calm is steady state, not an event
+    c3 = _ctrl()
+    c3.evaluate(0.0, _sig(occupancy=0.3))
+    action, reason = c3.evaluate(10.5, _sig(occupancy=0.3))
+    assert action is None and "L0" in reason
+
+
+def test_degrade_slo_burn_is_pressure():
+    c = _ctrl()
+    c.evaluate(0.0, _sig(slo_burn=0.8))
+    assert c.evaluate(2.5, _sig(slo_burn=0.8)) == ("escalate", "slo_burn")
+
+
+def test_degrade_stats_block_and_l3_sustained():
+    c = _ctrl(l3_sustained_s=30.0)
+    s = c.stats()
+    assert s["degrade_enabled"] is True
+    assert s["degrade_level"] == 0
+    assert s["degrade_level_name"] == LEVELS[0] == "normal"
+    assert s["degrade_l3_sustained"] is False
+    # L3 held past the budget: the rc-10 verdict flips
+    c._level = 3
+    c._l3_since = time.monotonic() - 100.0
+    s = c.stats()
+    assert s["degrade_l3_sustained"] is True
+    assert s["degrade_l3_age_s"] >= 99.0
+
+
+# ------------------------------------------- engine deadline + folding
+
+
+def test_engine_flush_expired_deadline_fails_fast(rng):
+    """A request whose deadline lapses while it waits for the batch
+    window dies at the flush gate with a structured deadline_exceeded —
+    it never occupies a padded batch slot."""
+    fake = _FakeForward()
+    with InferenceEngine(_cfg(max_batch=4, timeout_ms=150.0),
+                         forward_fn=fake) as eng:
+        fut = eng.submit(_img(rng), _img(rng), deadline_s=0.02)
+        with pytest.raises(ServeError) as ei:
+            fut.result(timeout=10)
+        assert ei.value.code == "deadline_exceeded"
+        stats = eng.stats()
+        assert stats["deadline_requests"] == 1
+        assert stats["deadline_flush_expired"] == 1
+        # the expired request was filtered OUT of the batch, and a
+        # deadline failure is the CALLER's budget, not a server error
+        assert fake.dispatches == 0
+        assert stats["serve_server_errors"] == 0
+        # a live sibling with budget still serves
+        assert eng.submit(_img(rng), _img(rng),
+                          deadline_s=30.0).result(timeout=10)["flow"].size
+
+
+def test_engine_enqueue_expired_deadline_under_backpressure(rng):
+    """queue_depth backpressure polls the deadline: a request that
+    cannot enter the queue before its budget lapses fails structured
+    instead of blocking the submitter past its own deadline."""
+    fake = _FakeForward(exec_s=0.5)
+    cfg = _cfg(max_batch=1, timeout_ms=1.0, queue_depth=1)
+    with InferenceEngine(cfg, forward_fn=fake) as eng:
+        f1 = eng.submit(_img(rng), _img(rng))  # dispatched, executor busy
+        time.sleep(0.1)
+        f2 = eng.submit(_img(rng), _img(rng))  # fills the queue
+        f3 = eng.submit(_img(rng), _img(rng), deadline_s=0.05)
+        with pytest.raises(ServeError) as ei:
+            f3.result(timeout=10)
+        assert ei.value.code == "deadline_exceeded"
+        assert eng.stats()["deadline_enqueue_expired"] == 1
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+
+
+def test_engine_degrade_level_folds_tier_and_bucket(rng):
+    """L1 serves default-precision requests at the cheapest configured
+    tier; L2 additionally drops one bucket rung; an EXPLICIT precision
+    is honored at any level. Every reached operating point is a
+    (bucket, tier) pair the warmup lattice already owns — the fold is
+    pure routing, no compile."""
+    cfg = _cfg(max_batch=1, timeout_ms=5.0,
+               buckets=((16, 32), (32, 64)), precisions=("f32", "bf16"))
+    with InferenceEngine(cfg, forward_fn=_FakeForward()) as eng:
+        r0 = eng.submit(_img(rng, (30, 60)), _img(rng, (30, 60)),
+                        degrade_level=0).result(timeout=10)
+        assert r0["precision"] == "f32" and r0["bucket"] == (32, 64)
+        r1 = eng.submit(_img(rng, (30, 60)), _img(rng, (30, 60)),
+                        degrade_level=1).result(timeout=10)
+        assert r1["precision"] == "bf16" and r1["bucket"] == (32, 64)
+        r2 = eng.submit(_img(rng, (30, 60)), _img(rng, (30, 60)),
+                        degrade_level=2).result(timeout=10)
+        assert r2["precision"] == "bf16" and r2["bucket"] == (16, 32)
+        # explicit tier survives the brownout
+        r3 = eng.submit(_img(rng, (30, 60)), _img(rng, (30, 60)),
+                        precision="f32", degrade_level=2).result(timeout=10)
+        assert r3["precision"] == "f32"
+        stats = eng.stats()
+        assert stats["degrade_tier_downgrades"] == 2
+        assert stats["degrade_bucket_downgrades"] == 2
+    # the ladder helper: one rung down, floor-clamped, off-ladder no-op
+    ladder = ((16, 32), (32, 64))
+    assert next_smaller_bucket((32, 64), ladder) == (16, 32)
+    assert next_smaller_bucket((16, 32), ladder) == (16, 32)
+    assert next_smaller_bucket((64, 64), ladder) == (64, 64)
+
+
+# --------------------------------------------- router admission + shed
+
+from conftest import free_port  # noqa: E402
+
+from deepof_tpu.serve.router import Router  # noqa: E402
+
+
+class _StubFleet:
+    """test_fleet.py's duck-typed Fleet for router unit tests."""
+
+    def __init__(self, ports, host="127.0.0.1"):
+        self.host = host
+        self.ports = list(ports)
+        self.size = len(self.ports)
+        self.failures = []
+
+    def ready_replicas(self):
+        return [SimpleNamespace(idx=i, port=p)
+                for i, p in enumerate(self.ports) if p is not None]
+
+    def note_failure(self, idx):
+        self.failures.append(idx)
+
+    def stats(self):
+        return {"fleet_replicas": self.size,
+                "fleet_ready": len(self.ready_replicas())}
+
+    def describe(self):
+        return []
+
+
+def _stub_replica():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"served_by": self.server.server_address[1],
+                               "deadline_ms_seen":
+                               self.headers.get("X-Deadline-Ms"),
+                               "level_seen":
+                               self.headers.get("X-Degrade-Level")}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def _router_cfg(log_dir):
+    cfg = get_config("flyingchairs")
+    return cfg.replace(
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(32, 64), gt_size=(32, 64)),
+        train=dataclasses.replace(cfg.train, log_dir=str(log_dir)))
+
+
+def _flow_body(rng, hw=(30, 60)) -> bytes:
+    def b64(img):
+        import base64
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        return base64.b64encode(buf.tobytes()).decode()
+
+    return json.dumps({"prev": b64(_img(rng, hw)),
+                       "next": b64(_img(rng, hw))}).encode()
+
+
+def test_router_admission_rejects_expired_deadline(rng, tmp_path):
+    """An already-expired deadline dies at the front door with 504
+    deadline_exceeded — it never reaches a replica; a live deadline is
+    re-stamped as REMAINING budget on the proxied hop."""
+    stub = _stub_replica()
+    try:
+        fleet = _StubFleet([stub.server_address[1]])
+        router = Router(_router_cfg(tmp_path), fleet)
+        body = _flow_body(rng)
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Deadline-Ms": "0"})
+        assert status == 504
+        assert json.loads(payload)["error"] == "deadline_exceeded"
+        assert router.stats()["deadline_admission_expired"] == 1
+        assert router.stats()["fleet_routed"] == {}  # never proxied
+        # a live deadline rides through, restamped as remaining ms
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Deadline-Ms": "30000"})
+        assert status == 200
+        seen = float(json.loads(payload)["deadline_ms_seen"])
+        assert 0.0 < seen <= 30000.0
+        # malformed budgets are the CLIENT's bug: structured 400
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Deadline-Ms": "soon"})
+        assert status == 400
+        assert json.loads(payload)["error"] == "bad_request"
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_router_l3_sheds_low_priority_first(rng, tmp_path):
+    """Priority shed ordering: at L3 a low-priority request answers a
+    structured 503 shed_low_priority at admission while default
+    traffic keeps serving (on the degraded operating point, stamped in
+    X-Degrade-Level); below L3 low-priority serves normally."""
+    stub = _stub_replica()
+    try:
+        fleet = _StubFleet([stub.server_address[1]])
+        router = Router(_router_cfg(tmp_path), fleet)
+        router.degrade_level = lambda: 3
+        body = _flow_body(rng)
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Priority": "low"})
+        assert status == 503
+        assert json.loads(payload)["error"] == "shed_low_priority"
+        assert router.stats()["degrade_shed_low"] == 1
+        # default traffic rides through with the live level stamped
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json")
+        assert status == 200
+        assert json.loads(payload)["level_seen"] == "3"
+        # below L3 the same low-priority request serves
+        router.degrade_level = lambda: 2
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Priority": "low"})
+        assert status == 200
+        # an unknown priority class is a client bug, not a guess
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", body, "application/json",
+            headers={"X-Priority": "urgent"})
+        assert status == 400
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_router_relays_replica_deadline_504_without_failover(rng,
+                                                             tmp_path):
+    """A replica's own deadline_exceeded 504 is the CALLER's verdict:
+    the router relays it — replaying the request on a sibling would
+    burn a second slot on work whose budget is already gone."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Expired(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"error": "deadline_exceeded",
+                               "message": "deadline expired"}).encode()
+            self.send_response(504)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    expired = ThreadingHTTPServer(("127.0.0.1", 0), Expired)
+    expired.daemon_threads = True
+    threading.Thread(target=expired.serve_forever, daemon=True).start()
+    healthy = _stub_replica()
+    try:
+        fleet = _StubFleet([expired.server_address[1],
+                            healthy.server_address[1]])
+        router = Router(_router_cfg(tmp_path), fleet)
+        status, payload, _ = router.handle_flow(
+            "/v1/flow", _flow_body(rng), "application/json",
+            headers={"X-Deadline-Ms": "5000"})
+        assert status == 504
+        assert json.loads(payload)["error"] == "deadline_exceeded"
+        assert router.stats()["fleet_failovers"] == 0
+        assert fleet.failures == []  # the replica is healthy, not sick
+    finally:
+        for s in (expired, healthy):
+            s.shutdown()
+            s.server_close()
+
+
+# ------------------------------------------------------------ tail rc 10
+
+
+def test_tail_exits_10_on_sustained_l3(tmp_path, capsys):
+    from deepof_tpu.cli import main as cli_main
+
+    def run_dir(name, sustained):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "metrics.jsonl").write_text("")
+        (d / "heartbeat.json").write_text(json.dumps({
+            "time": time.time(), "pid": os.getpid(), "step": 0,
+            "serve_requests": 50, "serve_responses": 50,
+            "degrade_enabled": True, "degrade_level": 3,
+            "degrade_level_name": "shed_low_priority",
+            "degrade_transitions": 3, "degrade_escalations": 3,
+            "degrade_recoveries": 0, "degrade_l3_entries": 1,
+            "degrade_l3_age_s": 45.0 if sustained else 1.0,
+            "degrade_l3_sustained": sustained,
+            "degrade_last_reason": "shed"}))
+        return d
+
+    rc = cli_main(["tail", "--log-dir", str(run_dir("browned", True))])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["degrade"]["l3_sustained"] is True
+    assert summary["degrade"]["level"] == 3
+    assert rc == 10
+    # L3 inside its budget is a brownout doing its job: rc 0
+    assert cli_main(["tail", "--log-dir",
+                     str(run_dir("bridging", False))]) == 0
+
+
+# ------------------------------------------------------ chaos drill
+
+
+def _load_serve_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serve_bench.py")
+    spec = importlib.util.spec_from_file_location("serve_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+def test_brownout_drill_protects_default_priority(tmp_path):
+    """The ISSUE 19 acceptance: the identical mixed-priority overload
+    against two live 2-replica fleets — brownout OFF sheds
+    default-priority work (>= 1), brownout ON sheds ZERO default
+    requests in the counted window, redirects the overload onto
+    low-priority sheds at L3, and the tier/bucket downgrade counters
+    prove the intermediate rungs actually served cheaper. Zero silent
+    drops on either leg."""
+    sb = _load_serve_bench()
+    res = sb.brownout_bench(replicas=2, default_clients=3, low_clients=8,
+                            ramp_s=1.5, window_s=2.0,
+                            log_dir=str(tmp_path))
+    assert res["default_sheds_on"] == 0
+    assert res["default_sheds_off"] >= 1
+    assert res["max_level_on"] == 3
+    assert res["shed_low_on"] >= 1
+    assert res["tier_downgrades_on"] >= 1
+    assert res["bucket_downgrades_on"] >= 1
+    assert res["drops"] == 0
+    # the schema the BENCH rounds pin
+    missing = [k for k in sb.BROWNOUT_REQUIRED_KEYS if k not in res]
+    assert not missing, missing
+    # the transition timeline landed in the ON leg's metrics.jsonl as
+    # kind="serve" records (the analyze/tail surface)
+    recs = []
+    with open(os.path.join(str(tmp_path), "leg_on", "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "serve" and "level_after" in rec:
+                recs.append(rec)
+    assert [r["level_after"] for r in recs][:3] == [1, 2, 3]
+    assert all(r["event"] == "degrade_escalate" for r in recs[:3])

@@ -118,6 +118,17 @@ PROXY_SPEC: tuple[tuple[str, tuple[str, ...], str], ...] = (
      ("serve_bench_quality", "p99_overhead_pct"), "lower"),
     ("bench_quality_photo_f32", ("serve_bench_quality", "tiers", "f32",
                                  "photo"), "lower"),
+    # r19 brownout plane (serve/degrade.py + serve_bench --brownout):
+    # the overload A/B's protection invariant — default-priority sheds
+    # on the controller-ON leg must pin at 0 (any nonzero flags against
+    # a best of 0 immediately) — and the headline absorbed-shed delta
+    # (OFF-leg default sheds minus ON-leg, the sheds the brownout plane
+    # redirected onto low-priority work; load-shape dependent, so the
+    # wide relative tolerance applies, like predictive_shed_delta)
+    ("bench_brownout_default_sheds_on",
+     ("serve_bench_brownout", "default_sheds_on"), "lower"),
+    ("bench_brownout_shed_delta",
+     ("serve_bench_brownout", "default_shed_delta"), "higher"),
 )
 
 #: noise-centered signed proxies: the overhead percentages hover around

@@ -197,6 +197,26 @@ QUALITY_REQUIRED_KEYS = (
 #: ... and per tier inside result["tiers"][<tier>]
 QUALITY_TIER_REQUIRED_KEYS = ("photo", "smooth", "census", "scored")
 
+#: keys every --brownout result carries (schema smoke test): the r19
+#: overload-ramp A/B — the identical mixed-priority overload (default
+#: clients inside fleet capacity + low-priority clients pushing past
+#: it) against two fresh 2-replica fleets, brownout controller ON vs
+#: OFF. The headline is default_shed_delta: with the controller off,
+#: saturation 503s land on default-priority traffic too
+#: (default_sheds_off >= 1); with it on, the ladder walks to L3 and
+#: sheds ONLY low-priority work at admission (default_sheds_on == 0 in
+#: the counted window), with the tier/bucket downgrade counters proving
+#: the intermediate rungs actually served cheaper.
+BROWNOUT_REQUIRED_KEYS = (
+    "mode", "replicas", "default_clients", "low_clients", "window_s",
+    "max_batch", "fake_exec_ms", "max_in_flight",
+    "default_sheds_off", "default_sheds_on", "default_shed_delta",
+    "shed_low_on", "max_level_on", "transitions_on",
+    "tier_downgrades_on", "bucket_downgrades_on",
+    "p99_default_off_ms", "p99_default_on_ms",
+    "low_ok_off", "low_ok_on", "drops", "wall_s",
+)
+
 
 def _bench_cfg(bucket: tuple[int, int], max_batch: int, timeout_ms: float,
                log_dir: str | None):
@@ -1080,31 +1100,40 @@ def fleet_bench(replicas: int = 2, requests: int = 96, clients: int = 8,
 
 
 def _drive_timed(port: int, body: bytes, clients: int,
-                 duration_s: float) -> dict:
+                 duration_s: float, headers: dict | None = None,
+                 collect_latency: bool = False) -> dict:
     """Closed-loop client pool for a fixed WINDOW (the ramp phases are
     time-staged, not count-staged): every worker hammers until the
     deadline. Returns {"ok", "errors", "drops"} — errors are structured
     non-200 replies (shed 503s land here), drops are transport-level
     failures where the client got NO response at all (the
-    zero-silent-drops ledger; the router must make this 0)."""
+    zero-silent-drops ledger; the router must make this 0). `headers`
+    ride every request on top of Content-Type (the brownout A/B sends
+    X-Priority/X-Deadline-Ms through here); `collect_latency` adds
+    client-observed latency_p50_ms/latency_p99_ms over the 200s."""
     import http.client
 
     deadline = time.perf_counter() + max(float(duration_s), 0.0)
     ok = [0] * clients
     err = [0] * clients
     drops = [0] * clients
+    lats: list[list[float]] = [[] for _ in range(clients)]
+    hdrs = {"Content-Type": "application/json", **(headers or {})}
 
     def worker(slot: int) -> None:
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         try:
             while time.perf_counter() < deadline:
                 try:
-                    conn.request("POST", "/v1/flow", body,
-                                 {"Content-Type": "application/json"})
+                    t_req = time.perf_counter()
+                    conn.request("POST", "/v1/flow", body, hdrs)
                     resp = conn.getresponse()
                     resp.read()
                     if resp.status == 200:
                         ok[slot] += 1
+                        if collect_latency:
+                            lats[slot].append(
+                                (time.perf_counter() - t_req) * 1e3)
                     else:
                         err[slot] += 1
                 except Exception:  # noqa: BLE001 - a silent drop, counted
@@ -1122,8 +1151,16 @@ def _drive_timed(port: int, body: bytes, clients: int,
         t.start()
     for t in threads:
         t.join()
-    return {"ok": sum(ok), "errors": sum(err), "drops": sum(drops),
-            "t0": round(t0, 2), "t1": round(time.time(), 2)}
+    out = {"ok": sum(ok), "errors": sum(err), "drops": sum(drops),
+           "t0": round(t0, 2), "t1": round(time.time(), 2)}
+    if collect_latency:
+        flat = sorted(x for slot in lats for x in slot)
+        out["latency_p50_ms"] = (
+            round(flat[len(flat) // 2], 2) if flat else None)
+        out["latency_p99_ms"] = (
+            round(flat[min(int(len(flat) * 0.99), len(flat) - 1)], 2)
+            if flat else None)
+    return out
 
 
 def _ramp_cfg(log_dir: str, max_replicas: int, max_batch: int,
@@ -1427,6 +1464,231 @@ def ramp_bench(max_replicas: int = 3, burst_clients: int = 8,
     }
 
 
+# ---------------------------------------------------------- brownout
+
+
+def _brownout_cfg(log_dir: str, max_batch: int, timeout_ms: float,
+                  exec_ms: float, max_in_flight: int,
+                  bucket: tuple[int, int], enabled: bool):
+    """Fleet config for one brownout A/B leg: a 2-rung bucket ladder
+    and a 2-tier precision ladder (so L1/L2 have somewhere cheaper to
+    go), a small per-replica in-flight cap (so the overload actually
+    saturates), and — on the ON leg — the degrade controller at a
+    compressed cadence (the same policy shape as production, like
+    `_ramp_cfg` compresses the autoscaler). recover_after_s is set
+    LONGER than the counted window: the drill measures protection at
+    L3, not the recovery walk (tests/test_degrade.py owns hysteresis)."""
+    import dataclasses as dc
+
+    cfg = _fleet_cfg(log_dir, max_batch, timeout_ms, exec_ms, bucket)
+    small = (max(bucket[0] // 2, 8), max(bucket[1] // 2, 8))
+    return cfg.replace(serve=dc.replace(
+        cfg.serve,
+        buckets=(small, tuple(bucket)),
+        precisions=("f32", "bf16"),
+        fleet=dc.replace(cfg.serve.fleet, max_in_flight=max_in_flight),
+        degrade=dc.replace(cfg.serve.degrade, enabled=enabled,
+                           period_s=0.1, escalate_after_s=0.2,
+                           recover_after_s=5.0, escalate_cooldown_s=0.3,
+                           recover_cooldown_s=1.0)))
+
+
+def _brownout_leg(base: str, enabled: bool, replicas: int,
+                  default_clients: int, low_clients: int, ramp_s: float,
+                  window_s: float, max_batch: int, timeout_ms: float,
+                  exec_ms: float, max_in_flight: int,
+                  bucket: tuple[int, int], body: bytes) -> dict:
+    """One brownout leg: a FRESH fleet under the identical
+    mixed-priority overload — `default_clients` closed-loop clients
+    inside fleet capacity (each carrying a generous X-Deadline-Ms, so
+    the deadline plumbing is live end to end) plus `low_clients`
+    X-Priority:low clients pushing the pool past saturation. Ramp
+    phase drives until the ON leg's controller reaches L3 (bounded),
+    then the counted window measures per-priority outcomes. Figures
+    come off the router's live /metrics scrape — the same path an
+    operator's collector reads."""
+    from deepof_tpu.obs.export import parse_prometheus
+    from deepof_tpu.serve.fleet import Fleet
+    from deepof_tpu.serve.router import Router, build_router_server
+
+    cfg = _brownout_cfg(base, max_batch, timeout_ms, exec_ms,
+                        max_in_flight, bucket, enabled)
+    out: dict = {"enabled": enabled}
+    max_level = 0
+    with Fleet(cfg, replicas) as fleet:
+        fleet.start()
+        fleet.wait_ready(min_ready=replicas,
+                         timeout_s=cfg.serve.fleet.spawn_timeout_s)
+        router = Router(cfg, fleet)
+        httpd = build_router_server(cfg, router)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        degr = None
+        if enabled:
+            from deepof_tpu.serve.degrade import DegradeController
+
+            degr = DegradeController(cfg, fleet, router)
+            router.degrade_stats = degr.stats  # scrape-visible
+            router.degrade_level = degr.level  # folded into routing
+            degr.start()
+        try:
+            def drive_mix(duration: float) -> tuple[dict, dict]:
+                res: list[dict | None] = [None, None]
+
+                def run(i, clients, headers, lat):
+                    res[i] = _drive_timed(port, body, clients, duration,
+                                          headers=headers,
+                                          collect_latency=lat)
+
+                pools = [
+                    threading.Thread(target=run, args=(
+                        0, default_clients,
+                        {"X-Deadline-Ms": "5000"}, True)),
+                    threading.Thread(target=run, args=(
+                        1, low_clients, {"X-Priority": "low"}, False)),
+                ]
+                for t in pools:
+                    t.start()
+                for t in pools:
+                    t.join()
+                return res[0], res[1]
+
+            # ramp: overload until the ON leg's ladder reaches L3 (the
+            # OFF leg gets the same minimum warm so the A/B windows see
+            # comparable queue state); bounded so a wedged controller
+            # fails the bench visibly instead of hanging it
+            ramp = {"ok": 0, "errors": 0, "drops": 0}
+            ramp_deadline = time.monotonic() + (
+                max(ramp_s, 10.0) if enabled else ramp_s)
+            min_until = time.monotonic() + ramp_s
+            while time.monotonic() < ramp_deadline:
+                d, lo = drive_mix(0.5)
+                for k in ramp:
+                    ramp[k] += d[k] + lo[k]
+                if degr is not None:
+                    max_level = max(max_level, degr.level())
+                if time.monotonic() >= min_until and (
+                        degr is None or max_level >= 3):
+                    break
+            out["ramp"] = ramp
+
+            # counted window: per-priority outcomes under the sustained
+            # overload — client-observed, so a shed is a shed whether it
+            # was the router's saturation 503 or the L3 priority shed
+            shed0 = router.stats()["fleet_shed"]
+            d, lo = drive_mix(window_s)
+            if degr is not None:
+                max_level = max(max_level, degr.level())
+            rs = router.stats()
+            out.update({
+                "default_ok": d["ok"], "default_sheds": d["errors"],
+                "low_ok": lo["ok"], "low_errors": lo["errors"],
+                "drops": ramp["drops"] + d["drops"] + lo["drops"],
+                "latency_p50_ms": d["latency_p50_ms"],
+                "latency_p99_ms": d["latency_p99_ms"],
+                "saturation_sheds_window": rs["fleet_shed"] - shed0,
+                "shed_low": rs.get("degrade_shed_low", 0),
+                "max_level": max_level,
+                "transitions": rs.get("degrade_transitions", 0),
+                "escalations": rs.get("degrade_escalations", 0),
+                "recoveries": rs.get("degrade_recoveries", 0),
+            })
+
+            # engine-side counters ride replica /healthz -> the fleet-
+            # aggregated /metrics scrape (all registry-declared keys)
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            try:
+                conn.request("GET", "/metrics")
+                samples = parse_prometheus(
+                    conn.getresponse().read().decode())
+            finally:
+                conn.close()
+            out.update({
+                "tier_downgrades": samples.get(
+                    "deepof_degrade_tier_downgrades", 0),
+                "bucket_downgrades": samples.get(
+                    "deepof_degrade_bucket_downgrades", 0),
+                "requests_with_deadline": samples.get(
+                    "deepof_deadline_requests", 0),
+                "scrape_degrade_level": samples.get("deepof_degrade_level"),
+            })
+        finally:
+            if degr is not None:
+                degr.close()
+            router.draining = True
+            httpd.shutdown()
+            httpd.server_close()
+    return out
+
+
+def brownout_bench(replicas: int = 2, default_clients: int = 3,
+                   low_clients: int = 8, ramp_s: float = 2.0,
+                   window_s: float = 3.0, max_batch: int = 2,
+                   timeout_ms: float = 2.0, exec_ms: float = 30.0,
+                   max_in_flight: int = 2,
+                   bucket: tuple[int, int] = (32, 64),
+                   native_hw: tuple[int, int] = (30, 60),
+                   log_dir: str | None = None) -> dict:
+    """The r19 brownout A/B (DESIGN.md "Brownout"): the identical
+    mixed-priority overload against two fresh fleets — controller OFF
+    (saturation sheds land indiscriminately, default-priority traffic
+    included) then ON (the ladder walks L1 tier -> L2 bucket -> L3
+    priority shed, recompile-free, and default-priority traffic rides
+    out the overload unshedded). `default_shed_delta` is the headline:
+    the default-priority sheds the brownout plane absorbed."""
+    import tempfile
+
+    base = log_dir or tempfile.mkdtemp(prefix="serve_bench_brownout_")
+    body = _flow_body(native_hw)
+    replicas = max(int(replicas), 2)
+    t0 = time.perf_counter()
+
+    off = _brownout_leg(os.path.join(base, "leg_off"), False, replicas,
+                        default_clients, low_clients, ramp_s, window_s,
+                        max_batch, timeout_ms, exec_ms, max_in_flight,
+                        bucket, body)
+    on = _brownout_leg(os.path.join(base, "leg_on"), True, replicas,
+                       default_clients, low_clients, ramp_s, window_s,
+                       max_batch, timeout_ms, exec_ms, max_in_flight,
+                       bucket, body)
+
+    return {
+        "mode": "brownout", "replicas": replicas,
+        "default_clients": default_clients, "low_clients": low_clients,
+        "window_s": window_s,
+        "default_sheds_off": off["default_sheds"],
+        "default_sheds_on": on["default_sheds"],
+        "default_shed_delta": (off["default_sheds"]
+                               - on["default_sheds"]),
+        "shed_low_on": on["shed_low"],
+        "max_level_on": on["max_level"],
+        "transitions_on": on["transitions"],
+        "escalations_on": on["escalations"],
+        "recoveries_on": on["recoveries"],
+        "tier_downgrades_on": on["tier_downgrades"],
+        "bucket_downgrades_on": on["bucket_downgrades"],
+        "requests_with_deadline_on": on["requests_with_deadline"],
+        "p99_default_off_ms": off["latency_p99_ms"],
+        "p99_default_on_ms": on["latency_p99_ms"],
+        "p50_default_off_ms": off["latency_p50_ms"],
+        "p50_default_on_ms": on["latency_p50_ms"],
+        "default_ok_off": off["default_ok"],
+        "default_ok_on": on["default_ok"],
+        "low_ok_off": off["low_ok"], "low_ok_on": on["low_ok"],
+        "low_errors_off": off["low_errors"],
+        "low_errors_on": on["low_errors"],
+        "drops": off["drops"] + on["drops"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "max_batch": max_batch, "fake_exec_ms": exec_ms,
+        "max_in_flight": max_in_flight, "bucket": list(bucket),
+        "log_dir": base,
+        "legs": {"off": off, "on": on},
+    }
+
+
 # ---------------------------------------------------- artifact cold start
 
 
@@ -1635,6 +1897,16 @@ def main(argv=None) -> int:
                     help="ramp mode: autoscale_up_slope threshold armed "
                          "in the predictive compare leg (completions/s "
                          "trend per second)")
+    ap.add_argument("--brownout", action="store_true",
+                    help="r19 brownout A/B (DESIGN.md \"Brownout\"): the "
+                         "identical mixed-priority overload against two "
+                         "fresh fleets, degrade controller off vs on — "
+                         "default-priority sheds must collapse to 0 on "
+                         "the ON leg while low-priority work sheds at "
+                         "L3 and the tier/bucket downgrade counters "
+                         "prove the intermediate rungs served cheaper")
+    ap.add_argument("--window-s", type=float, default=3.0,
+                    help="brownout mode: counted overload window per leg")
     ap.add_argument("--artifact-cold", action="store_true",
                     help="r16 zero-cold-start A/B: publish the ladder "
                          "into the executable artifact store, then time "
@@ -1718,6 +1990,20 @@ def main(argv=None) -> int:
                          if t.strip())
                    if args.precision is not None else ("f32",)),
             log_dir=args.log_dir)
+    elif args.brownout:
+        # like --ramp: absent flags keep the brownout's own tuned
+        # defaults (exec 30 ms / flush 2 ms / batch 2 / in-flight 2 —
+        # the saturate-then-shed dynamics the A/B is built on)
+        res = brownout_bench(window_s=args.window_s,
+                             max_batch=user_batch if user_batch is not None
+                             else 2,
+                             exec_ms=user_exec if user_exec is not None
+                             else 30.0,
+                             timeout_ms=user_timeout
+                             if user_timeout is not None else 2.0,
+                             bucket=hw(args.bucket),
+                             native_hw=hw(args.native),
+                             log_dir=args.log_dir)
     elif args.ramp:
         # explicit flags pass through; absent ones keep the ramp's own
         # tuned defaults (exec 30 ms / flush 2 ms / batch 2 — the shed-
