@@ -565,7 +565,8 @@ def bench(model_name: str = "inception_v3", batch: int = 16,
 
 def data_bench(num_workers: int = 0, batch: int = 8, image_size=(64, 64),
                batches: int = 32, dataset: str = "synthetic",
-               data_path: str = "", seed: int = 0) -> dict:
+               data_path: str = "", seed: int = 0,
+               recipe_path: str = "") -> dict:
     """Host input-pipeline throughput in ISOLATION (batches/s, MB/s):
     dataset decode/assembly through `data/pipeline.py`'s worker pool,
     no model, no train step — so host vs. device bottlenecks are
@@ -590,7 +591,7 @@ def data_bench(num_workers: int = 0, batch: int = 8, image_size=(64, 64),
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         return _data_bench(num_workers, batch, image_size, batches,
-                           dataset, data_path, seed)
+                           dataset, data_path, seed, recipe_path)
     finally:
         if prev_platforms is None:
             os.environ.pop("JAX_PLATFORMS", None)
@@ -599,7 +600,7 @@ def data_bench(num_workers: int = 0, batch: int = 8, image_size=(64, 64),
 
 
 def _data_bench(num_workers, batch, image_size, batches, dataset,
-                data_path, seed) -> dict:
+                data_path, seed, recipe_path="") -> dict:
     import numpy as np  # noqa: F811 - the compute-import convention here
 
     from deepof_tpu.core.config import DataConfig
@@ -607,10 +608,33 @@ def _data_bench(num_workers, batch, image_size, batches, dataset,
     from deepof_tpu.data.pipeline import InputPipeline, derive_batch_rng
 
     h, w = image_size
-    cfg = DataConfig(dataset=dataset, data_path=data_path,
-                     image_size=(h, w), gt_size=(h, w), batch_size=batch,
-                     num_workers=num_workers)
-    ds = build_dataset(cfg)
+    if recipe_path:
+        # mixed-stream proxy: the recipe's FIRST stage weighted mixture
+        # assembled through the same pipeline — measures the mixture
+        # layer's sampling/normalization overhead vs. a single dataset
+        from deepof_tpu.core.config import recipe_from_dict
+        from deepof_tpu.data.mixture import build_mixture
+
+        with open(recipe_path) as f:
+            recipe = recipe_from_dict(json.load(f))
+        if not recipe.stages:
+            raise SystemExit(f"--recipe {recipe_path!r}: no stages")
+        stage = recipe.stages[0]
+        sh, sw = stage.image_size or (h, w)
+        h, w = sh, sw
+        cfg = DataConfig(dataset=dataset, data_path=data_path,
+                         image_size=(sh, sw),
+                         gt_size=stage.gt_size or (sh, sw),
+                         crop_size=stage.crop_size, batch_size=batch,
+                         time_step=stage.time_step or 2,
+                         num_workers=num_workers)
+        ds = build_mixture(cfg, stage)
+        dataset = "+".join(m.dataset for m in stage.mixture)
+    else:
+        cfg = DataConfig(dataset=dataset, data_path=data_path,
+                         image_size=(h, w), gt_size=(h, w),
+                         batch_size=batch, num_workers=num_workers)
+        ds = build_dataset(cfg)
 
     def assemble(i: int) -> dict:
         return ds.sample_train(batch, rng=derive_batch_rng(seed, i))
@@ -655,6 +679,11 @@ def _data_bench(num_workers, batch, image_size, batches, dataset,
         "decode_cache_misses": int(cache["misses"]),
         "decode_cache_evictions": int(cache["evictions"]),
     }
+    if recipe_path and hasattr(ds, "mixture_stats"):
+        # which member each timed batch actually drew — the weighted
+        # split is part of the measurement's identity
+        res["draws_by_dataset"] = dict(
+            ds.mixture_stats()["recipe_draws_by_dataset"])
     assert np.isfinite(bps)
     return res
 
@@ -688,11 +717,15 @@ def data_main(argv: list[str]) -> int:
                    metavar="HxW")
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--data-path", default="")
+    p.add_argument("--recipe", default="", metavar="FILE",
+                   help="time the recipe's first-stage weighted mixture "
+                        "stream (data/mixture.py) instead of --dataset")
     args = p.parse_args([a for a in argv if a != "--data"])
     h, w = parse_image_size(args.image_size)
     res = data_bench(num_workers=args.workers, batch=args.batch,
                      image_size=(h, w), batches=args.batches,
-                     dataset=args.dataset, data_path=args.data_path)
+                     dataset=args.dataset, data_path=args.data_path,
+                     recipe_path=args.recipe)
     print(json.dumps(res), flush=True)
     return 0
 

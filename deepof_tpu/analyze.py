@@ -163,6 +163,15 @@ def _exec_counters(rec: dict) -> dict:
             if k.startswith("exec_") and v is not None}
 
 
+def _recipe_counters(rec: dict) -> dict:
+    """`recipe_*` counters from one record or heartbeat sample (the
+    staged-recipe engine, train/recipe.py: active stage index/count,
+    stage advances, the deterministic mixture's per-dataset draw
+    counts, and the newest advance trigger's cause)."""
+    return {k[len("recipe_"):]: v for k, v in rec.items()
+            if k.startswith("recipe_") and v is not None}
+
+
 def _ledger_rows(log_dir: str) -> list[dict]:
     """The run dir's ledger.jsonl rows, [] when it recorded none —
     loaded ONCE per tail/analyze pass and shared by the condensed
@@ -325,6 +334,11 @@ def summarize(records: list[dict]) -> dict:
         counters = _counter_summary(newest)
         if counters:
             out["counters"] = counters
+        # staged-recipe block (train/recipe.py extra_stats ride every
+        # periodic train record): stage index, advances, mixture draws
+        recipe = _recipe_counters(newest)
+        if recipe:
+            out["recipe"] = recipe
 
     evals = _finite(by_kind.get("eval", []), "aee")
     if evals:
@@ -445,7 +459,8 @@ def _process_summary(d: str, now: float) -> dict:
                           ("degrade", _degrade_counters),
                           ("deadline", _deadline_counters),
                           ("elastic", _elastic_counters),
-                          ("exec", _exec_counters)):
+                          ("exec", _exec_counters),
+                          ("recipe", _recipe_counters)):
         block = extract(newest)
         if block:
             out[name] = block
@@ -558,6 +573,9 @@ def tail_summary(log_dir: str, recent: int = 10,
         counters = _counter_summary(last)
         if counters:
             out.update({k: v for k, v in counters.items() if k != "data"})
+        recipe = _recipe_counters(last)
+        if recipe:
+            out["recipe"] = recipe
 
     evals = [r for r in records if r.get("kind") == "eval"]
     if evals:
@@ -620,6 +638,12 @@ def tail_summary(log_dir: str, recent: int = 10,
         execs = _exec_counters(hb)
         if execs:
             out["exec"] = execs
+        # a recipe-driven trainer's heartbeat carries the live recipe_*
+        # block (stage, advances, mixture draws) — fresher than the
+        # newest train record, wins per block
+        recipe = _recipe_counters(hb)
+        if recipe:
+            out["recipe"] = recipe
 
     serves = [r for r in records if r.get("kind") == "serve"]
     if serves:

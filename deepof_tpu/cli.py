@@ -60,6 +60,27 @@ def _apply_override(cfg: ExperimentConfig, dotted: str, raw: str) -> ExperimentC
     return rec(cfg, dotted.split("."))
 
 
+def _recipe_from_file(cfg: ExperimentConfig, path: str) -> ExperimentConfig:
+    """Load a `--recipe FILE` JSON (a RecipeConfig dict, train/recipe.py)
+    into the config. The file implies recipe.enabled; unknown keys are
+    rejected at every nesting level (stages[i], stages[i].mixture[j])."""
+    from .core.config import recipe_from_dict
+
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise SystemExit(f"--recipe {path!r}: {e}")
+    if not isinstance(d, dict):
+        raise SystemExit(f"--recipe {path!r}: expected a JSON object "
+                         '(a RecipeConfig dict with a "stages" list)')
+    d.setdefault("enabled", True)
+    try:
+        return cfg.replace(recipe=recipe_from_dict(d))
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"--recipe {path!r}: {e}")
+
+
 def _build_cfg(args) -> ExperimentConfig:
     if getattr(args, "config_json", None):
         # the fleet's parent->replica handoff: the exact serialized
@@ -81,6 +102,10 @@ def _build_cfg(args) -> ExperimentConfig:
             gt_size=(64, 64), batch_size=8, crop_size=None, time_step=2),
             train=dataclasses.replace(cfg.train, eval_batch_size=8,
                                       eval_amplifier=1.0))
+    if getattr(args, "recipe", None):
+        # before --set so explicit --set recipe.* overrides win over
+        # the file (same convention as every sugar flag above)
+        cfg = _recipe_from_file(cfg, args.recipe)
     # serve session/autoscale sugar: the flags ride the same
     # nested-override path as --set (and before it, so an explicit
     # --set still wins)
@@ -129,6 +154,16 @@ def main(argv=None) -> int:
     p_train.add_argument("--epochs", type=int, default=None)
     p_train.add_argument("--max-steps", "--steps", dest="max_steps",
                          type=int, default=None)
+    p_train.add_argument("--recipe", default=None, metavar="FILE",
+                         help="staged training-recipe JSON (DESIGN.md "
+                              "\"Recipe engine\"): an ordered stage list, "
+                              "each with a weighted dataset mixture "
+                              "(deterministic for any data.num_workers), "
+                              "per-stage shape/time_step/loss/lr "
+                              "overrides, and an advance trigger — fixed "
+                              "steps or the eval_trend sustained-AEE-"
+                              "plateau signal. Implies recipe.enabled; "
+                              "--set recipe.* still wins")
     p_train.add_argument("--profile", action="store_true",
                          help="whole-run jax.profiler trace (includes "
                               "compile; grows with run length)")
@@ -172,6 +207,22 @@ def main(argv=None) -> int:
                         help="image-path pairs, colon-separated")
     p_pred.add_argument("--out", required=True, help="output directory")
     p_pred.add_argument("--no-png", action="store_true")
+    p_pred.add_argument("--action", action="store_true",
+                        help="classify each pair with a trained action "
+                             "head (st_single/st_baseline/ucf101_spatial "
+                             "— the UCF-101 workload) instead of "
+                             "predicting flow: writes <out>/actions.json "
+                             "with top-k classes + softmax probs per "
+                             "pair")
+    p_pred.add_argument("--labels", default=None, metavar="FILE",
+                        help="--action: class-name file (one name per "
+                             "line, index order) to attach names to "
+                             "predictions")
+    p_pred.add_argument("--ckpt-dir", default=None, metavar="DIR",
+                        help="--action: explicit checkpoint directory "
+                             "(a recipe run's final stage lives under "
+                             "<log-dir>/ckpt-stage<i>, not <log-dir>/"
+                             "ckpt)")
     p_pred.add_argument("--precision", default=None,
                         choices=("f32", "bf16", "int8"),
                         help="serving precision tier (must be in "
@@ -190,6 +241,12 @@ def main(argv=None) -> int:
     _add_common(p_warm)
     p_warm.add_argument("--no-eval", action="store_true",
                         help="skip the eval executable")
+    p_warm.add_argument("--recipe", default=None, metavar="FILE",
+                        help="AOT-compile EVERY stage of this training-"
+                             "recipe JSON — one (train, eval) executable "
+                             "pair per stage — so a later `train "
+                             "--recipe` run switches stages with zero "
+                             "recompiles (provable from the ledger)")
     p_warm.add_argument("--serve", action="store_true",
                         help="also AOT-compile the serve ladder "
                              "(serve.buckets x serve.precisions "
@@ -299,6 +356,11 @@ def main(argv=None) -> int:
                               "(flyingchairs/sintel/ucf101/synthetic)")
     p_bench.add_argument("--data-path", default="",
                          help="data-only mode: dataset root on disk")
+    p_bench.add_argument("--recipe", default=None, metavar="FILE",
+                         help="data-only mode: time the recipe's first-"
+                              "stage weighted MIXTURE stream "
+                              "(data/mixture.py) through the pipeline "
+                              "instead of a single --dataset")
 
     p_an = sub.add_parser("analyze", help="summarize a run's metrics log")
     p_an.add_argument("--log-dir", required=True)
@@ -827,7 +889,8 @@ def main(argv=None) -> int:
                                        batches=args.batches,
                                        image_size=(h, w),
                                        dataset=args.dataset,
-                                       data_path=args.data_path)
+                                       data_path=args.data_path,
+                                       recipe_path=args.recipe or "")
         else:
             res = bench_mod.bench(model_name=args.model, batch=args.batch,
                                   steps=args.steps)
@@ -857,6 +920,12 @@ def main(argv=None) -> int:
             if args.epochs is not None:
                 raise SystemExit("train: elastic mode needs an absolute "
                                  "target step (--max-steps), not --epochs")
+            if cfg.recipe.enabled and cfg.recipe.stages:
+                raise SystemExit(
+                    "train: --elastic and --recipe are exclusive — the "
+                    "recipe engine drives staged single-pool runs "
+                    "(train/recipe.py); run each stage elastically via "
+                    "per-stage configs instead")
             # the train-package import chain below initializes a jax
             # backend (orbax does, at import): the coordinator must
             # defuse it FIRST, in EVERY mode — it computes nothing, a
@@ -926,6 +995,15 @@ def main(argv=None) -> int:
                 return 2
         if args.serve_only:
             res = warmup_serve(cfg)
+        elif cfg.recipe.enabled and cfg.recipe.stages:
+            # recipe mode (via --recipe FILE or --set recipe.*): one
+            # (train, eval) executable pair PER STAGE — the stage-switch
+            # zero-recompile contract's warm half (train/recipe.py)
+            from .train.warmup import warmup_recipe
+
+            res = warmup_recipe(cfg)
+            if args.serve:
+                res["serve"] = warmup_serve(cfg)
         else:
             res = warmup_compile(cfg, include_eval=not args.no_eval)
             if args.serve:
@@ -963,14 +1041,27 @@ def main(argv=None) -> int:
         return run_server(cfg)
 
     if args.cmd == "predict":
-        from .predict import predict_pairs
-
         pairs = []
         for item in args.pairs:
             if ":" not in item:
                 raise SystemExit(f"bad --pairs {item!r}: use prev.png:next.png")
             prev, nxt = item.split(":", 1)
             pairs.append((prev, nxt))
+        if args.action:
+            from .predict import predict_action
+
+            labels = None
+            if args.labels:
+                with open(args.labels) as f:
+                    labels = [ln.strip() for ln in f if ln.strip()]
+            rows = predict_action(cfg, pairs, args.out, labels=labels,
+                                  ckpt_dir=args.ckpt_dir)
+            print(json.dumps(
+                {"written": [os.path.join(args.out, "actions.json")],
+                 "actions": rows}))
+            return 0
+        from .predict import predict_pairs
+
         written = predict_pairs(cfg, pairs, args.out,
                                 write_png=not args.no_png,
                                 precision=args.precision)
@@ -1000,6 +1091,16 @@ def main(argv=None) -> int:
         # before Trainer(): model build + first compile can take minutes,
         # and a preemption SIGTERM in that window must still checkpoint
         install_preemption_latch()
+        if cfg.recipe.enabled and cfg.recipe.stages:
+            # staged recipe run (train/recipe.py): one Trainer per
+            # stage over the curriculum's mixtures, stage index riding
+            # the checkpoint manifests, pre-compiled stage executables
+            from .train.recipe import run_recipe
+
+            out = run_recipe(cfg, max_steps=args.max_steps,
+                             num_epochs=args.epochs)
+            print(json.dumps(out))
+            return 0
     trainer = Trainer(cfg, profile=getattr(args, "profile", False),
                       profile_steps=profile_steps)
     if args.cmd == "train":

@@ -812,6 +812,77 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class MixtureMemberConfig:
+    """One weighted member of a stage's dataset mixture (data/mixture.py).
+
+    Empty/zero fields inherit the stage-resolved DataConfig, so a member
+    usually names only its dataset and weight. All members of a stage
+    must agree on per-sample structure (shape, dtype, implied
+    time_step) — validated loudly at build time, naming the stage."""
+
+    dataset: str = "synthetic"  # flyingchairs | sintel | ucf101 | synthetic
+    weight: float = 1.0
+    data_path: str = ""  # "" = the stage's data.data_path
+    sintel_pass: str = ""  # "" = the stage's data.sintel_pass
+    time_step: int = 0  # 0 = the stage's data.time_step
+
+
+@dataclass(frozen=True)
+class StageConfig:
+    """One stage of a training recipe (train/recipe.py): a weighted
+    dataset mixture plus per-stage overrides of the base config and an
+    advance condition. Sentinel values (None / 0 / empty) inherit the
+    base ExperimentConfig, so a stage names only what it changes."""
+
+    name: str = "stage"
+    # weighted dataset mixture; () = the base config's single dataset
+    mixture: tuple[MixtureMemberConfig, ...] = ()
+    # --- per-stage config overrides (sentinels inherit the base) ---
+    image_size: tuple[int, int] | None = None
+    gt_size: tuple[int, int] | None = None
+    crop_size: tuple[int, int] | None = None
+    time_step: int = 0
+    batch_size: int = 0
+    model: str = ""  # e.g. the UCF-101 action stage swaps in st_single
+    loss_weights: tuple[float, ...] = ()
+    learning_rate: float = 0.0  # this stage's lr-schedule segment base
+    # --- advance condition ---
+    # "steps": advance after exactly `steps` optimizer steps.
+    # "plateau": advance when the stage's eval-AEE trend (analyze.py
+    #   eval_trend over this stage's evals) has flattened — slope >=
+    #   -plateau_slope AEE per 1000 steps over plateau_window evals —
+    #   with `steps` (when > 0) as a hard step budget backstop.
+    advance: str = "steps"
+    steps: int = 0  # 0 = unbounded (terminal stage / plateau-only)
+    plateau_window: int = 8
+    plateau_slope: float = 0.01  # flat when slope >= -this (AEE/kstep)
+    min_evals: int = 3  # plateau needs at least this many stage evals
+
+
+@dataclass(frozen=True)
+class RecipeConfig:
+    """Staged training recipe (train/recipe.py, DESIGN.md "Recipe
+    engine"): an ordered list of stages, each with a deterministic
+    weighted dataset mixture, per-stage shape/time_step/loss/lr
+    overrides, and a fixed-step or EPE-plateau advance condition. The
+    active stage index rides the checkpoint manifest so resume — plain
+    or post-reform — lands in the correct stage; `warmup` pre-compiles
+    every stage's executable set so a stage switch is a zero-recompile
+    event provable from the executable ledger."""
+
+    enabled: bool = False
+    stages: tuple[StageConfig, ...] = ()
+    # AOT pre-compile every stage's (train, eval) executables at recipe
+    # start (train/recipe.py precompile_stages) so stage boundaries
+    # compile nothing mid-run. False = compile lazily per stage.
+    warmup: bool = True
+    # eval cadence driving the plateau trigger rides the per-stage
+    # train.eval_every; this caps how many stage evals the trigger
+    # retains (bounded memory on very long stages)
+    max_trigger_evals: int = 512
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "flyingchairs_flownet_s"
     # any models/registry.py name: flownet_s | vgg16 | inception_v3 |
@@ -843,6 +914,7 @@ class ExperimentConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    recipe: RecipeConfig = field(default_factory=RecipeConfig)
 
     def replace(self, **kw: Any) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
@@ -951,9 +1023,21 @@ def _from_dict(cls: type, d: dict, path: str = "") -> Any:
             continue  # absent fields keep their defaults (older dumps)
         value = d[f.name]
         hint = hints.get(f.name)
+        where = f"{path}.{f.name}" if path else f.name
         if dataclasses.is_dataclass(hint) and isinstance(value, dict):
-            value = _from_dict(hint, value, f"{path}.{f.name}" if path
-                               else f.name)
+            value = _from_dict(hint, value, where)
+        elif (typing.get_origin(hint) is tuple
+              and typing.get_args(hint)
+              and dataclasses.is_dataclass(typing.get_args(hint)[0])
+              and isinstance(value, (list, tuple))):
+            # tuple-of-dataclass fields (recipe.stages, stage.mixture):
+            # each element recurses with an indexed path so unknown-key
+            # rejection names the exact offending entry
+            elem = typing.get_args(hint)[0]
+            value = tuple(
+                _from_dict(elem, v, f"{where}[{i}]")
+                if isinstance(v, dict) else _tupleize(v)
+                for i, v in enumerate(value))
         else:
             value = _tupleize(value)
         kwargs[f.name] = value
@@ -971,3 +1055,12 @@ def config_from_dict(d: dict) -> ExperimentConfig:
     field must not silently become its default); missing keys keep
     their defaults so older dumps load."""
     return _from_dict(ExperimentConfig, d)
+
+
+def recipe_from_dict(d: dict) -> RecipeConfig:
+    """Strict dict -> RecipeConfig for the `train --recipe FILE` payload
+    (train/recipe.py): the same unknown-key rejection as
+    `config_from_dict`, at every nesting level — a typo in
+    `stages[i].mixture[j]` fails with the exact indexed path, never a
+    silently-defaulted field."""
+    return _from_dict(RecipeConfig, d, "recipe")

@@ -25,6 +25,7 @@ from .datasets import (
     UCF101Data,
     build_dataset,
 )
+from .mixture import MixtureDataset, build_mixture
 from .pipeline import InputPipeline, derive_batch_rng
 from .prefetch import Prefetcher
 
@@ -41,6 +42,8 @@ __all__ = [
     "SyntheticData",
     "UCF101Data",
     "build_dataset",
+    "MixtureDataset",
+    "build_mixture",
     "InputPipeline",
     "derive_batch_rng",
     "Prefetcher",

@@ -57,7 +57,7 @@ from dataclasses import dataclass
 #: must resolve in this registry.
 LINTED_PREFIXES: tuple[str, ...] = (
     "serve_", "fleet_", "elastic_", "data_", "fault_", "exec_",
-    "incident_", "alert_", "degrade_", "deadline_")
+    "incident_", "alert_", "degrade_", "deadline_", "recipe_")
 
 MERGE_KINDS: frozenset[str] = frozenset((
     "sum", "max", "gauge", "bool", "hist", "map", "state", "derived"))
@@ -295,6 +295,18 @@ _ENTRIES: list[Key] = [
            resilience=True),
     # non-resilience ckpt counter (rides the same ckpt_ stats prefix)
     Key("ckpt_saves", "sum", "ckpt"),
+    # ------------------- recipe_* (train/recipe.py, the staged-recipe
+    # engine): active stage (per-process identity, never merged),
+    # stage-advance events, the per-member mixture draw counts the
+    # deterministic sampler accumulates, and the cause of the newest
+    # advance trigger ("steps" | "plateau"). Ride every train-side
+    # stats surface (heartbeat, train records, fit summary) via the
+    # Trainer's extra_stats hook.
+    *_keys("recipe", "gauge", "recipe_stage", "recipe_stages"),
+    Key("recipe_advances", "sum", "recipe"),
+    Key("recipe_draws_by_dataset", "map", "recipe"),
+    Key("recipe_last_trigger", "state", "recipe"),
+    Key("recipe_stage_name", "state", "recipe"),
     # --------------- incident_*/alert_* (obs/incident.py, the flight
     # recorder): capture/dedup/rate-limit accounting plus the alert-
     # rule engine. Deliberately NOT resilience-surfaced — the legacy

@@ -29,9 +29,10 @@ from .io.flo import write_flo
 from .utils.flowviz import flow_to_color
 
 
-def restore_params(cfg: ExperimentConfig):
-    """Params from the newest VERIFIED checkpoint under
-    cfg.train.log_dir (Trainer layout).
+def _restore_verified(cfg: ExperimentConfig, model, channels: int,
+                      ckpt_dir: str | None = None):
+    """Params of `model` from the newest VERIFIED checkpoint under
+    `ckpt_dir` (default: cfg.train.log_dir's Trainer layout).
 
     Restore goes through the resilience layer's manifest verification
     (`train/checkpoint.py` + `resilience/verify.py`): a candidate whose
@@ -40,18 +41,15 @@ def restore_params(cfg: ExperimentConfig):
     never loads a torn or bit-flipped checkpoint. Disable with
     resilience.verify_checkpoints=false.
     """
-    from .serve.engine import build_serve_model
     from .train.checkpoint import CheckpointManager
     from .train.schedule import step_decay_schedule
     from .train.state import create_train_state, make_optimizer
 
-    t = cfg.data.time_step
-    model = build_serve_model(cfg)
     h, w = cfg.data.image_size  # eval-protocol resolution (val is uncropped)
     tx = make_optimizer(cfg.optim, step_decay_schedule(cfg.optim, 1))
     template = create_train_state(
-        model, jnp.zeros((1, h, w, 3 * t)), tx, seed=0)
-    ckpt_dir = cfg.train.log_dir + "/ckpt"
+        model, jnp.zeros((1, h, w, channels)), tx, seed=0)
+    ckpt_dir = ckpt_dir or cfg.train.log_dir + "/ckpt"
     mgr = CheckpointManager(ckpt_dir, async_save=False, create=False,
                             verify=cfg.resilience.verify_checkpoints)
     state = mgr.restore(template)
@@ -67,6 +65,43 @@ def restore_params(cfg: ExperimentConfig):
             f"no checkpoint under {ckpt_dir} (run `python -m deepof_tpu "
             f"verify-ckpt {cfg.train.log_dir}` to inspect the directory)")
     return model, state.params
+
+
+def restore_params(cfg: ExperimentConfig):
+    """(model, params) for the flow predict/serve path — see
+    `_restore_verified` for the verification contract."""
+    from .serve.engine import build_serve_model
+
+    return _restore_verified(cfg, build_serve_model(cfg),
+                             3 * cfg.data.time_step)
+
+
+def restore_action_params(cfg: ExperimentConfig, ckpt_dir: str | None = None):
+    """(model, params) for the action predict path: the full training
+    model (the checkpoint's exact param tree — the serve path's
+    `build_serve_model` strips the action head, which is precisely the
+    part this path needs).
+
+    ckpt_dir: explicit checkpoint directory override — a recipe run's
+    final stage lives under <log_dir>/ckpt-stage<i> (train/recipe.py),
+    not the plain Trainer's <log_dir>/ckpt.
+    """
+    from .models.registry import build_model
+
+    t = cfg.data.time_step
+    dtype = (jnp.bfloat16 if cfg.train.compute_dtype == "bfloat16"
+             else jnp.float32)
+    model = build_model(cfg.model, flow_channels=2 * (t - 1), dtype=dtype,
+                        width_mult=cfg.width_mult,
+                        corr_max_disp=cfg.corr_max_disp,
+                        corr_stride=cfg.corr_stride)
+    if not (getattr(model, "has_action_head", False)
+            or getattr(model, "classifier_only", False)):
+        raise ValueError(
+            f"model {cfg.model!r} has no action head — the action predict "
+            "path needs st_single, st_baseline, or ucf101_spatial")
+    channels = 3 if getattr(model, "classifier_only", False) else 3 * t
+    return _restore_verified(cfg, model, channels, ckpt_dir=ckpt_dir)
 
 
 def write_outputs(out_dir: str, stem: str, flow: np.ndarray,
@@ -148,3 +183,67 @@ def predict_pairs(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
         while buf:
             drain_one()
     return written
+
+
+def predict_action(cfg: ExperimentConfig, pairs: list[tuple[str, str]],
+                   out_dir: str, model_params=None,
+                   labels: list[str] | None = None, top_k: int = 5,
+                   ckpt_dir: str | None = None) -> list[dict]:
+    """Classify (prev, next) frame pairs with a trained action model
+    (the UCF-101 workload: st_single / st_baseline two-stream heads, or
+    the ucf101_spatial single-frame classifier — which ignores the
+    `next` frame by construction).
+
+    Each pair becomes one network input at cfg.data.image_size through
+    the SAME preprocess the trainer applies (resize, BGR mean subtract,
+    /255 — serve/buckets.py); the head's softmax yields the top_k
+    classes. Returns the per-pair prediction rows and writes them to
+    <out_dir>/actions.json.
+
+    labels: optional class-name list (index order) to attach names.
+    model_params: optional (model, params) override (tests; callers
+    that already restored). ckpt_dir: see `restore_action_params`.
+    """
+    import json
+
+    import jax
+
+    from .data.datasets import DATASET_MEANS
+    from .serve.buckets import prepare_frame, prepare_pair
+
+    if model_params is not None:
+        model, params = model_params
+    else:
+        model, params = restore_action_params(cfg, ckpt_dir=ckpt_dir)
+    mean = DATASET_MEANS.get(cfg.data.dataset, DATASET_MEANS["flyingchairs"])
+    h, w = cfg.data.image_size
+    spatial_only = getattr(model, "classifier_only", False)
+
+    @jax.jit
+    def fwd(p, x):
+        out = model.apply({"params": p}, x, train=False)
+        logits = out if spatial_only else out[1]
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    rows: list[dict] = []
+    for src_path, tgt_path in pairs:
+        src, tgt = cv2.imread(src_path), cv2.imread(tgt_path)
+        if src is None or tgt is None:
+            missing = src_path if src is None else tgt_path
+            raise FileNotFoundError(f"cannot read image {missing!r}")
+        x = (prepare_frame(src, (h, w), mean) if spatial_only
+             else prepare_pair(src, tgt, (h, w), mean))[None]
+        probs = np.asarray(fwd(params, x))[0]
+        order = np.argsort(probs)[::-1][: max(top_k, 1)]
+        top = [{"class": int(i),
+                **({"label": labels[i]} if labels and i < len(labels)
+                   else {}),
+                "prob": round(float(probs[i]), 6)} for i in order]
+        rows.append({"source": src_path, "target": tgt_path,
+                     **{k: top[0][k] for k in ("class", "label", "prob")
+                        if k in top[0]},
+                     "top": top})
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "actions.json"), "w") as f:
+        json.dump(rows, f, indent=2)
+    return rows

@@ -70,10 +70,14 @@ def config_digest(cfg_dict) -> str:
 
 def build_manifest(ckpt_path: str, step: int,
                    structure: dict | None = None,
-                   cfg_digest: str | None = None) -> dict:
+                   cfg_digest: str | None = None,
+                   extra: dict | None = None) -> dict:
     """Inventory the COMMITTED checkpoint directory (call only after the
     write has fully committed — for async saves that is after
-    `wait_until_finished`)."""
+    `wait_until_finished`). ``extra`` is an optional jsonable block the
+    writer rides along (e.g. the recipe engine's active stage index so
+    resume lands in the correct stage); it is carried verbatim and never
+    participates in verification."""
     files: dict[str, dict] = {}
     for root, _, names in os.walk(ckpt_path):
         for nm in sorted(names):
@@ -85,7 +89,7 @@ def build_manifest(ckpt_path: str, step: int,
         content = zlib.crc32(
             f"{rel}:{files[rel]['size']}:{files[rel]['crc32']};".encode(),
             content)
-    return {
+    manifest = {
         "version": MANIFEST_VERSION,
         "step": int(step),
         "time": time.time(),
@@ -94,6 +98,9 @@ def build_manifest(ckpt_path: str, step: int,
         "structure": structure,
         "config_digest": cfg_digest,
     }
+    if extra:
+        manifest["extra"] = dict(extra)
+    return manifest
 
 
 def write_manifest(ckpt_path: str, manifest: dict) -> str:

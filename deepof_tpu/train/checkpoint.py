@@ -35,7 +35,8 @@ class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3, create: bool = True,
                  async_save: bool = True, verify: bool = True,
                  log=None, injector=None, config_digest: str | None = None,
-                 writer: bool = True, info_log=None):
+                 writer: bool = True, info_log=None,
+                 manifest_extra: dict | None = None):
         """create=False opens read-only (no mkdir side effect — e.g. the
         transfer-init source, where a typo'd path must not leave a phantom
         empty run directory behind).
@@ -70,7 +71,11 @@ class CheckpointManager:
         they must resume from it on every re-form, but concurrent
         writers at different steps would race the prune/clobber
         directory surgery, so exactly one host (the generation's
-        primary) writes."""
+        primary) writes.
+        manifest_extra: optional jsonable block ridden verbatim on every
+        manifest this manager writes (e.g. the recipe engine's active
+        stage index, train/recipe.py) — readable back via
+        `read_manifest_extra()` without touching the orbax payload."""
         self.directory = os.path.abspath(directory)
         self.keep = keep
         self._verify = verify
@@ -79,6 +84,7 @@ class CheckpointManager:
         self._config_digest = config_digest
         self._writer = writer
         self._info_log = info_log
+        self._manifest_extra = manifest_extra
         self._pending_manifest: tuple[int, dict] | None = None
         # recovery-event counters (GIL-atomic int bumps; heartbeat reads)
         self._saves = 0
@@ -154,7 +160,8 @@ class CheckpointManager:
         try:
             manifest = ckpt_verify.build_manifest(
                 path, step, structure=structure,
-                cfg_digest=self._config_digest)
+                cfg_digest=self._config_digest,
+                extra=self._manifest_extra)
             ckpt_verify.write_manifest(path, manifest)
         except OSError as e:
             self._warn(step, f"checkpoint manifest write failed at step "
@@ -256,6 +263,23 @@ class CheckpointManager:
         if not hasattr(self._ckpt, "wait_until_finished"):
             self._flush_manifest()
         return path
+
+    def read_manifest_extra(self, step: int | None = None) -> dict | None:
+        """The ``extra`` block of a committed checkpoint's manifest
+        (newest step when None) — how the recipe engine learns which
+        stage a resume belongs to, jax-free. None when the checkpoint or
+        its manifest is absent (legacy / torn manifests report as
+        no-extra, not as errors)."""
+        self._wait()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        manifest = ckpt_verify.load_manifest(
+            ckpt_verify.manifest_path(self._path(step)))
+        if manifest is None:
+            return None
+        extra = manifest.get("extra")
+        return dict(extra) if isinstance(extra, dict) else None
 
     def _rm_manifest(self, step: int) -> None:
         try:

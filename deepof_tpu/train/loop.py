@@ -160,8 +160,27 @@ def _example_input(cfg: ExperimentConfig) -> jnp.ndarray:
 class Trainer:
     def __init__(self, cfg: ExperimentConfig, dataset=None, mesh=None,
                  profile: bool = False,
-                 profile_steps: tuple[int, int] | None = None):
+                 profile_steps: tuple[int, int] | None = None,
+                 ckpt_dir: str | None = None,
+                 train_step=None, eval_fn=None, tx=None,
+                 manifest_extra: dict | None = None,
+                 extra_stats=None, on_eval=None):
+        # The recipe engine (train/recipe.py) drives one Trainer per
+        # stage through these hooks: ckpt_dir isolates each stage's
+        # checkpoint lineage, train_step/eval_fn inject the stage's
+        # PRE-COMPILED executables (so a stage switch is a
+        # zero-recompile event provable from the ledger) — with tx the
+        # EXACT optimizer object those executables were lowered against
+        # (a Compiled's input pytree pins the TrainState's static tx
+        # metadata by identity, so a freshly built twin would not
+        # match), manifest_extra rides the active stage index on every
+        # checkpoint manifest, extra_stats() merges recipe counters
+        # into heartbeat/train records/fit summary, and on_eval(step,
+        # metrics) -> bool ends fit() early when the stage's advance
+        # trigger fires (eval_trend plateau).
         self.cfg = cfg
+        self._extra_stats = extra_stats
+        self._on_eval = on_eval
         # Persistent compile cache BEFORE any compile (init, train, eval):
         # a process whose config was warmed (`deepof_tpu warmup`) or simply
         # run before loads executables instead of recompiling — the
@@ -200,7 +219,7 @@ class Trainer:
         self.steps_per_epoch = max(self.dataset.num_train // cfg.data.batch_size, 1)
         schedule = step_decay_schedule(cfg.optim, self.steps_per_epoch)
         self.schedule = schedule
-        tx = make_optimizer(cfg.optim, schedule)
+        tx = tx if tx is not None else make_optimizer(cfg.optim, schedule)
         self.state = create_train_state(
             self.model, _example_input(cfg), tx, seed=cfg.train.seed,
             log=lambda m: self.logger.log("info", 0, message=m))
@@ -220,7 +239,8 @@ class Trainer:
         # it on (re)spawn — so a re-formed world resumes from one
         # consistent state and a lost primary's torn last write falls
         # back to the previous valid step (train/elastic.py).
-        ckpt_dir = (el.ckpt_dir if self._elastic_child and el.ckpt_dir
+        ckpt_dir = (ckpt_dir if ckpt_dir
+                    else el.ckpt_dir if self._elastic_child and el.ckpt_dir
                     else cfg.train.log_dir + "/ckpt")
         ckpt_writer = (not self._elastic_child
                        or el.host_index == el.primary_host)
@@ -238,7 +258,7 @@ class Trainer:
             info_log=lambda s, m: self.logger.log("info", s, message=m),
             injector=self._inj,
             config_digest=config_digest(dataclasses.asdict(digest_src)),
-            writer=ckpt_writer)
+            writer=ckpt_writer, manifest_extra=manifest_extra)
         # VGG16 pretrained conv-trunk init (`flyingChairsTrain.py:60-76`);
         # fresh starts only — a checkpoint to resume from takes precedence.
         _vgg_trunks = {"vgg16": ("encoder",), "st_single": ("encoder",),
@@ -327,11 +347,15 @@ class Trainer:
                             "only replicate work")
 
         smooth_border = cfg.model in ("st_single", "st_baseline")
-        self.train_step = make_train_step(self.model, cfg, self.dataset.mean,
-                                          self.mesh, smooth_border)
-        self.eval_fn = make_eval_fn(self.model, cfg, self.dataset.mean,
-                                    mesh=self.mesh,
-                                    smooth_border_mask=smooth_border)
+        self._injected_step = train_step is not None
+        self.train_step = (train_step if train_step is not None else
+                           make_train_step(self.model, cfg,
+                                           self.dataset.mean,
+                                           self.mesh, smooth_border))
+        self.eval_fn = (eval_fn if eval_fn is not None else
+                        make_eval_fn(self.model, cfg, self.dataset.mean,
+                                     mesh=self.mesh,
+                                     smooth_border_mask=smooth_border))
         if jax.process_count() > 1:
             # Multi-host eval: every host loads the same full val batch
             # (deterministic), contributes its rows to the global array,
@@ -547,7 +571,9 @@ class Trainer:
                        for sk, sv in self.ckpt.stats().items()},
                     **({f"fault_{sk}": sv
                         for sk, sv in inj.stats().items()}
-                       if inj is not None else {})}
+                       if inj is not None else {}),
+                    **(self._extra_stats()
+                       if self._extra_stats is not None else {})}
         # Liveness heartbeat + wedge watchdog (obs/heartbeat.py): a
         # background thread atomically rewrites heartbeat.json with
         # step/rates/depths/device-memory/RSS, and dumps every thread's
@@ -836,11 +862,18 @@ class Trainer:
                     if cfg.obs.flops and lowered is not None:
                         # every periodic record then carries model_tflops
                         self._flops_per_step = lowered_flops(lowered)
-                    if ledger is not None:
+                    if ledger is not None and not self._injected_step:
                         # compile_kind="first_step": first_wall includes
                         # one EXECUTED step stride, a different unit
                         # from warmup's pure lower+compile "aot" rows —
-                        # diff_ledgers only bounds like against like
+                        # diff_ledgers only bounds like against like.
+                        # An INJECTED pre-compiled step (recipe engine)
+                        # records nothing: its compile already owns an
+                        # "aot" row (train_step_stage<i>) and its first
+                        # dispatch is execution, not compile — keeping
+                        # the ledger a pure compile record is what
+                        # makes "a stage switch added zero rows"
+                        # provable from it
                         ledger.record("train_step", lowered=lowered,
                                       compile_s=first_wall,
                                       compile_kind="first_step", cache=dc)
@@ -966,6 +999,17 @@ class Trainer:
                     timer.pause()  # eval time is not training throughput
                     if heartbeat is not None:
                         heartbeat.touch()  # a long sweep is not a wedge
+                    if (self._on_eval is not None
+                            and self._on_eval(gstep, dict(last_eval))):
+                        # recipe advance trigger (train/recipe.py): end
+                        # this stage's fit at the eval boundary; the
+                        # normal finalize path below writes the clean
+                        # final checkpoint the next stage resumes from
+                        self.logger.log(
+                            "info", gstep,
+                            message="on_eval hook requested stop at step "
+                                    f"{gstep} (stage advance trigger)")
+                        break
                 if ckpt_due:
                     with obs_trace.span("ckpt", step=gstep):
                         saved = self.ckpt.save(self.state)

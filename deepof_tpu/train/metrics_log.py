@@ -33,6 +33,11 @@ from ..resilience.healing import retry_bounded
 def _scalarize(v):
     if v is None or isinstance(v, (str, bool, int)):
         return v
+    if isinstance(v, dict):
+        # map/state-kind counters (obs/registry.py) ride train records
+        # as nested objects — e.g. the recipe engine's
+        # recipe_draws_by_dataset — scalarized value-wise
+        return {k: _scalarize(x) for k, x in v.items()}
     a = np.asarray(v)
     return a.tolist() if a.ndim else float(a)
 
@@ -46,6 +51,8 @@ def _json_safe(v):
         return None
     if isinstance(v, list):
         return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
     return v
 
 

@@ -1,0 +1,382 @@
+"""Staged training-recipe engine (DESIGN.md "Recipe engine").
+
+The reference ships three disjoint trainers — FlyingChairs pairs,
+Sintel 10-frame volumes, UCF-101 two-stream (`flyingChairsTrain.py`,
+`sintelTrain.py`, `ucf101train.py`) — and its published results come
+from running them in sequence by hand. `run_recipe` replaces that with
+one declarative `RecipeConfig`: an ordered list of stages, each naming
+a weighted dataset mixture (data/mixture.py), per-stage overrides of
+the base config (image size, time_step, model, loss weights, lr), and
+an advance condition — a fixed step count or the `eval_trend`
+sustained-AEE-plateau signal (analyze.py).
+
+Mechanics, in terms of existing planes rather than new ones:
+
+- Each stage runs a fresh `Trainer` against a stage-resolved config and
+  an injected mixture dataset. The mixed stream inherits the
+  `derive_batch_rng` determinism contract wholesale — bit-identical for
+  any `data.num_workers` and across elastic generation bumps — because
+  the member CHOICE is folded from the same per-batch rng.
+- Each stage owns its checkpoint lineage (`<log_dir>/ckpt-stage<i>`),
+  and every manifest the stage writes carries
+  ``extra = {recipe_stage, recipe_stage_name, stage_start_step}`` —
+  resume (plain or post-reform) scans the stage directories newest
+  first and lands in exactly the stage the newest valid manifest names.
+- Stage i+1 starts from stage i's params via `transfer_params` (the
+  same shape-matched graft the Chairs->Sintel fine-tune path uses), and
+  the global step carries across stages so LR schedules and records
+  stay monotonic.
+- `precompile_stages` AOT-compiles every stage's (train, eval)
+  executable pair through `ExecutableLedger.record_aot` before step 1,
+  and injects the Compiled objects into each stage's Trainer — a stage
+  switch mid-run executes, it never compiles, and the ledger proves it
+  (tools/ledger_diff.py: zero non-warmup compile rows at the boundary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+from ..analyze import eval_trend
+from ..core.config import ExperimentConfig, StageConfig
+from ..data.mixture import MixtureDataset, build_mixture
+from ..resilience import verify as ckpt_verify
+
+
+def stage_ckpt_dir(cfg: ExperimentConfig, index: int) -> str:
+    """Per-stage checkpoint lineage: stages may disagree on pytree
+    structure (model / time_step overrides), so sharing one directory
+    would make every cross-stage candidate fail structure verification
+    noise-first; one directory per stage keeps each lineage clean."""
+    return f"{cfg.train.log_dir}/ckpt-stage{index}"
+
+
+def stage_config(cfg: ExperimentConfig, stage: StageConfig) -> ExperimentConfig:
+    """The base config with this stage's non-sentinel overrides applied
+    (None / 0 / "" / () inherit — a stage names only what it changes)."""
+    data = cfg.data
+    if stage.image_size is not None:
+        data = dataclasses.replace(data, image_size=tuple(stage.image_size))
+    if stage.gt_size is not None:
+        data = dataclasses.replace(data, gt_size=tuple(stage.gt_size))
+    if stage.crop_size is not None:
+        data = dataclasses.replace(data, crop_size=tuple(stage.crop_size))
+    if stage.time_step:
+        data = dataclasses.replace(data, time_step=stage.time_step)
+    if stage.batch_size:
+        data = dataclasses.replace(data, batch_size=stage.batch_size)
+    if stage.mixture:
+        # the first member is the stage's face for anything that reads
+        # cfg.data.dataset (telemetry, eval protocol selection)
+        data = dataclasses.replace(data, dataset=stage.mixture[0].dataset)
+    out = cfg.replace(data=data)
+    if stage.model:
+        out = out.replace(model=stage.model)
+    if stage.loss_weights:
+        out = out.replace(loss=dataclasses.replace(
+            out.loss, weights=tuple(float(w) for w in stage.loss_weights)))
+    if stage.learning_rate:
+        out = out.replace(optim=dataclasses.replace(
+            out.optim, learning_rate=stage.learning_rate))
+    return out
+
+
+def stage_dataset(scfg: ExperimentConfig, stage: StageConfig):
+    """The stage's dataset: its weighted mixture, or the stage-resolved
+    base dataset when the stage declares no mixture."""
+    if stage.mixture:
+        return build_mixture(scfg.data, stage)
+    from ..data import build_dataset
+
+    return build_dataset(scfg.data)
+
+
+def plateau_reached(stage: StageConfig, evals: list[dict]) -> bool:
+    """The EPE-plateau advance condition, pure in its inputs: True when
+    `eval_trend` over this stage's eval records reports an AEE slope
+    that has flattened to >= -plateau_slope AEE per 1000 steps (i.e. no
+    longer improving faster than the declared threshold), with at least
+    max(min_evals, 3) finite stage evals seen."""
+    if len(evals) < max(stage.min_evals, 3):
+        return False
+    trend = eval_trend(evals, window=max(stage.plateau_window, 3))
+    if trend is None or not math.isfinite(trend["slope_aee_per_kstep"]):
+        return False
+    return trend["slope_aee_per_kstep"] >= -abs(stage.plateau_slope)
+
+
+def find_resume_stage(cfg: ExperimentConfig) -> tuple[int, dict]:
+    """(stage index, newest manifest extra) a resume lands in: the
+    HIGHEST stage whose checkpoint directory holds a committed step —
+    the manifest's ``extra.recipe_stage`` is authoritative when present
+    (it survives directory renames), the directory index otherwise.
+    (0, {}) for a fresh run. jax-free: callable from tools/tests."""
+    for i in reversed(range(len(cfg.recipe.stages))):
+        steps = ckpt_verify._step_dirs(stage_ckpt_dir(cfg, i))
+        if not steps:
+            continue
+        manifest = ckpt_verify.load_manifest(
+            ckpt_verify.manifest_path(steps[-1][1]))
+        extra = (manifest or {}).get("extra")
+        extra = dict(extra) if isinstance(extra, dict) else {}
+        return int(extra.get("recipe_stage", i)), extra
+    return 0, {}
+
+
+def precompile_stages(cfg: ExperimentConfig, mesh=None,
+                      stages: "list[int] | None" = None
+                      ) -> tuple[dict, dict]:
+    """AOT-compile every stage's (train, eval) executable pair before
+    the recipe's first step (`warmup_compile`'s lower-then-compile
+    pattern, once per stage), recording each through
+    `ExecutableLedger.record_aot` — the rows that later prove a stage
+    switch compiled nothing.
+
+    Returns (built, report): ``built[i]`` holds the stage's dataset,
+    mesh, and Compiled ``train_step``/``eval_fn`` for injection into
+    that stage's Trainer — the SAME dataset object must feed both the
+    lowering (its mean is baked into the step) and the Trainer, or the
+    executables would not match. ``report`` is the jsonable warmup
+    summary (per-stage compile seconds, fingerprints, cache verdict).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.registry import build_model
+    from ..obs.ledger import ExecutableLedger
+    from ..parallel.mesh import build_mesh
+    from .schedule import step_decay_schedule
+    from .state import create_train_state, make_optimizer
+    from .step import make_eval_fn, make_train_step
+    from .warmup import _sds, cache_delta, example_train_batch
+
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+    ledger = ExecutableLedger(cfg.train.log_dir, enabled=cfg.obs.ledger,
+                              backend=jax.default_backend())
+    built: dict[int, dict] = {}
+    report: dict[str, Any] = {"backend": jax.default_backend(),
+                              "stages": []}
+    with cache_delta() as delta:
+        for i, stage in enumerate(cfg.recipe.stages):
+            if stages is not None and i not in stages:
+                continue
+            scfg = stage_config(cfg, stage)
+            dataset = stage_dataset(scfg, stage)
+            t = scfg.data.time_step
+            dtype = (jnp.bfloat16
+                     if scfg.train.compute_dtype == "bfloat16"
+                     else jnp.float32)
+            model = build_model(scfg.model, flow_channels=2 * (t - 1),
+                                dtype=dtype, width_mult=scfg.width_mult,
+                                corr_max_disp=scfg.corr_max_disp,
+                                corr_stride=scfg.corr_stride)
+            steps_per_epoch = max(
+                dataset.num_train // scfg.data.batch_size, 1)
+            tx = make_optimizer(scfg.optim,
+                                step_decay_schedule(scfg.optim,
+                                                    steps_per_epoch))
+            h, w = scfg.data.crop_size or scfg.data.image_size
+            channels = 3 if scfg.model == "ucf101_spatial" else 3 * t
+            example = jax.ShapeDtypeStruct(
+                (scfg.data.batch_size, h, w, channels), jnp.float32)
+            state_sds = jax.eval_shape(
+                lambda x, m=model, o=tx, s=scfg: create_train_state(
+                    m, x, o, seed=s.train.seed),
+                example)
+            smooth_border = scfg.model in ("st_single", "st_baseline")
+            step = make_train_step(model, scfg, dataset.mean, mesh,
+                                   smooth_border)
+            batch_sds = _sds(example_train_batch(scfg, dataset))
+            train_compiled, row = ledger.record_aot(
+                f"train_step_stage{i}",
+                lambda s=step, a=state_sds, b=batch_sds: s.lower(a, b))
+            shards = mesh.shape["data"]
+            eval_bs = max(scfg.train.eval_batch_size // shards, 1) * shards
+            eval_fn = make_eval_fn(model, scfg, dataset.mean, mesh=mesh,
+                                   smooth_border_mask=smooth_border)
+            eval_sds = _sds({k: np.asarray(v) for k, v in
+                             dataset.sample_val(eval_bs, 0).items()})
+            eval_compiled, erow = ledger.record_aot(
+                f"eval_step_stage{i}",
+                lambda f=eval_fn, p=state_sds.params, b=eval_sds:
+                f.lower(p, b))
+            # tx rides along: the Compiled train step's input pytree
+            # pins the TrainState's static optimizer metadata by object
+            # identity — the stage Trainer must build its state around
+            # THIS tx, not a freshly made twin
+            built[i] = {"dataset": dataset, "mesh": mesh, "tx": tx,
+                        "train_step": train_compiled,
+                        "eval_fn": eval_compiled}
+            report["stages"].append(
+                {"stage": i, "name": stage.name, "model": scfg.model,
+                 "time_step": t,
+                 "train_compile_s": row["compile_s"],
+                 "eval_compile_s": erow["compile_s"],
+                 "train_fingerprint": row["fingerprint"],
+                 "eval_fingerprint": erow["fingerprint"]})
+    report["cache"] = delta.stats()
+    return built, report
+
+
+def run_recipe(cfg: ExperimentConfig, max_steps: int | None = None,
+               num_epochs: int | None = None) -> dict:
+    """Drive the staged recipe end to end (``train --recipe``).
+
+    Resumes stage-correct from the newest stage checkpoint (manifest
+    ``extra``), pre-compiles every remaining stage's executables when
+    ``recipe.warmup`` (zero-recompile stage switches), runs each stage's
+    Trainer to its advance condition, and grafts params forward across
+    stage boundaries. ``max_steps`` bounds TOTAL optimizer steps across
+    all stages this call (the CLI's --max-steps contract). Returns a
+    jsonable summary: final stage/step, per-stage advance causes, the
+    last stage's fit summary scalars."""
+    import jax.numpy as jnp
+
+    from .checkpoint import transfer_params
+    from .loop import Trainer
+
+    stages = cfg.recipe.stages
+    if not stages:
+        raise ValueError("recipe.enabled with no recipe.stages declared")
+    start_stage, resume_extra = find_resume_stage(cfg)
+    built, warm_report = ({}, None)
+    if cfg.recipe.warmup:
+        built, warm_report = precompile_stages(
+            cfg, stages=list(range(start_stage, len(stages))))
+
+    per_stage: list[dict] = []
+    advances = 0
+    last_trigger = ""
+    gstep = 0
+    budget_left = max_steps  # total across every stage's fit
+    prev_params = None
+    summary: dict[str, float] = {}
+    for i in range(start_stage, len(stages)):
+        stage = stages[i]
+        scfg = stage_config(cfg, stage)
+        entry = built.get(i, {})
+        dataset = entry.get("dataset")
+        if dataset is None:
+            dataset = stage_dataset(scfg, stage)
+        # stage_start_step: where this stage's step budget counts from —
+        # for a resumed stage the value its manifests recorded, else the
+        # global step the previous stage handed over
+        if i == start_stage and resume_extra.get("recipe_stage") == i:
+            stage_start = int(resume_extra.get("stage_start_step", gstep))
+        else:
+            stage_start = gstep
+
+        evals: list[dict] = []
+        trigger = {"cause": ""}
+
+        def on_eval(step, metrics, _stage=stage, _evals=evals,
+                    _trigger=trigger):
+            if _stage.advance != "plateau":
+                return False
+            aee = metrics.get("aee")
+            if aee is None or not math.isfinite(float(aee)):
+                return False
+            _evals.append({"step": int(step), "aee": float(aee)})
+            del _evals[:-max(cfg.recipe.max_trigger_evals, 8)]
+            if plateau_reached(_stage, _evals):
+                _trigger["cause"] = "plateau"
+                return True
+            return False
+
+        def recipe_stats(_i=i, _dataset=dataset):
+            out = {"recipe_stage": _i, "recipe_stages": len(stages),
+                   "recipe_advances": advances,
+                   "recipe_last_trigger": last_trigger or None}
+            if isinstance(_dataset, MixtureDataset):
+                out.update(_dataset.mixture_stats())
+            return out
+
+        trainer = Trainer(
+            scfg, dataset=dataset, mesh=entry.get("mesh"),
+            ckpt_dir=stage_ckpt_dir(cfg, i),
+            train_step=entry.get("train_step"),
+            eval_fn=entry.get("eval_fn"), tx=entry.get("tx"),
+            manifest_extra={"recipe_stage": i,
+                            "recipe_stage_name": stage.name,
+                            "stage_start_step": stage_start},
+            extra_stats=recipe_stats, on_eval=on_eval)
+        if int(trainer.state.step) == 0 and prev_params is not None:
+            # fresh stage: graft the previous stage's params (trunk
+            # transfers, shape-mismatched heads re-init — the
+            # Chairs->Sintel handoff) and carry the global step so the
+            # LR schedule and every record stay monotonic across stages
+            params, n_copied, n_skipped = transfer_params(
+                trainer.state.params, prev_params)
+            trainer.state = trainer.state.replace(
+                params=params,
+                step=jnp.asarray(
+                    gstep, jnp.asarray(trainer.state.step).dtype))
+            trainer.logger.log(
+                "info", gstep,
+                message=f"recipe stage {i} ({stage.name}): started at "
+                        f"step {gstep}; {n_copied} tensors grafted from "
+                        f"stage {i - 1}, {n_skipped} re-initialized")
+        gstep = int(trainer.state.step)
+
+        # step budget of this fit: the stage's own target (absolute:
+        # stage_start + steps) intersected with the recipe-wide cap
+        remaining = None
+        if stage.steps > 0:
+            remaining = stage_start + stage.steps - gstep
+        if budget_left is not None:
+            remaining = (budget_left if remaining is None
+                         else min(remaining, budget_left))
+        stage_out: dict[str, float] = {}
+        if remaining is None or remaining > 0:
+            # epochs sized so the epoch budget never truncates a
+            # steps/plateau-bounded stage
+            if remaining is not None:
+                epochs = max(
+                    -(-(gstep + remaining) // trainer.steps_per_epoch) + 1,
+                    1)
+            else:
+                epochs = num_epochs or scfg.train.num_epochs
+            stage_out = trainer.fit(num_epochs=epochs, max_steps=remaining)
+        new_gstep = int(trainer.state.step)
+        if budget_left is not None:
+            budget_left -= max(new_gstep - gstep, 0)
+        gstep = new_gstep
+        prev_params = trainer.state.params
+        summary = stage_out
+
+        cause = trigger["cause"]
+        if not cause and stage.steps > 0 and \
+                gstep >= stage_start + stage.steps:
+            cause = "steps"
+        elif not cause:
+            cause = "budget"  # epoch/--max-steps budget ended the fit
+        per_stage.append({"stage": i, "name": stage.name,
+                          "start_step": stage_start, "end_step": gstep,
+                          "advance": cause})
+        out_of_budget = budget_left is not None and budget_left <= 0
+        if i + 1 < len(stages) and not out_of_budget \
+                and cause in ("steps", "plateau"):
+            advances += 1
+            last_trigger = cause
+            trainer.logger.log(
+                "info", gstep,
+                message=f"recipe advance: stage {i} ({stage.name}) -> "
+                        f"stage {i + 1} ({stages[i + 1].name}) on "
+                        f"'{cause}' at step {gstep}")
+            continue
+        break  # terminal stage, exhausted budget, or untriggered fit
+
+    result = {"final_stage": per_stage[-1]["stage"] if per_stage else
+              start_stage,
+              "global_step": gstep, "advances": advances,
+              "last_trigger": last_trigger or None,
+              "per_stage": per_stage,
+              **{k: float(v) for k, v in summary.items()
+                 if isinstance(v, (int, float))}}
+    if warm_report is not None:
+        result["warmup_cache"] = warm_report.get("cache")
+    return result

@@ -281,6 +281,21 @@ def _ledger_report_entry(row: dict) -> dict:
             "cache_misses": row["cache_misses"]}
 
 
+def warmup_recipe(cfg: ExperimentConfig) -> dict:
+    """AOT-compile every recipe stage's (train, eval) executable pair
+    (`warmup` with recipe stages configured). One lower+compile pass
+    per stage through `recipe.precompile_stages` populates the
+    persistent cache and writes one `train_step_stage<i>` /
+    `eval_step_stage<i>` ledger row per executable — the baseline
+    ledger_diff later holds a recipe run against to prove its stage
+    switches compiled nothing."""
+    from .recipe import precompile_stages
+
+    enable_for_config(cfg)
+    _, report = precompile_stages(cfg)
+    return report
+
+
 def warmup_serve(cfg: ExperimentConfig) -> dict:
     """AOT-compile the serve ladder into the persistent cache
     (`warmup --serve`): one inference executable per configured
